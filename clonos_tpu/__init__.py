@@ -1,0 +1,29 @@
+"""clonos_tpu — a TPU-native stream-processing fault-tolerance framework.
+
+Capabilities of Clonos (PSilvestre/Clonos, SIGMOD '21; causal logging +
+standby tasks + in-flight-log replay on Apache Flink 1.7), re-imagined for
+JAX/XLA/Pallas on TPU:
+
+- exactly-once, highly-available streaming dataflows
+- nondeterminism tolerated via *determinant logging*: input interleaving
+  order, timestamps, RNG draws, timer firings, checkpoint RPC arrivals and
+  output buffer cuts are recorded as packed fixed-width tensor records in HBM
+- determinant replication rides step-boundary collectives over the device
+  mesh instead of per-message Netty piggybacking
+- recovery replay is a vectorized XLA scan over the determinant tensors
+- standby tasks restore pushed checkpoints and replay only the lost epochs
+
+Layer map (mirrors SURVEY.md §1 of the reference):
+  api/       user API: StreamExecutionEnvironment, DataStream, services
+  graph/     StreamGraph -> JobGraph translation, vertex graph info
+  runtime/   task plane: superstep executor, channels, checkpoints, scheduler
+  causal/    the causal fault-tolerance core (determinants, logs, recovery)
+  inflight/  epoch-scoped in-flight log of emitted batches (spillable)
+  parallel/  mesh/sharding/collective helpers
+  ops/       Pallas kernels for the hot paths
+  config/    typed configuration system
+"""
+
+from clonos_tpu.version import __version__
+
+__all__ = ["__version__"]

@@ -1,0 +1,371 @@
+"""Determinant schema: packed fixed-width tensor records.
+
+Capability parity with the reference's determinant type family
+(flink-runtime .../causal/determinant/Determinant.java:20-35 tag numbering;
+payload classes OrderDeterminant.java:23, TimestampDeterminant.java:26,
+RNGDeterminant.java:26, SerializableDeterminant.java,
+TimerTriggerDeterminant.java:26, SourceCheckpointDeterminant.java:40-43,
+IgnoreCheckpointDeterminant.java:32, BufferBuiltDeterminant.java:36, and the
+AsyncDeterminant record-count contract) — but as a TPU-native layout instead
+of a variable-width JVM byte codec:
+
+    one determinant == one row of int32[NUM_LANES]
+
+        lane 0: tag
+        lane 1: record_count   (the AsyncDeterminant replay target; 0 for
+                                synchronous determinants)
+        lanes 2..7: payload    (64-bit values split hi/lo across two lanes)
+
+A thread causal log is therefore a single ``int32[capacity, 8]`` ring buffer
+in HBM; append is a dynamic-update-slice, delta extraction is a slice, replay
+is a vectorized scan. The variable-width SERIALIZABLE payload does not fit a
+fixed row, so its bytes live in a host-side *sidecar* blob store and the row
+carries ``(sidecar_key, length, crc32)`` — rare/slow-path by design (it only
+covers external-service calls, reference CausalSerializableServiceFactory).
+
+The JVM encoder's GC-avoiding object pool (DeterminantPool.java) has no
+analog here: rows are values, not objects.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import zlib
+from typing import ClassVar, Dict, List, Optional, Sequence, Tuple, Type, Union
+
+import numpy as np
+
+# --- tags (numbering matches reference Determinant.java:20-35) --------------
+
+ORDER = 0
+TIMESTAMP = 1
+RNG = 2
+SERIALIZABLE = 3
+TIMER_TRIGGER = 4
+SOURCE_CHECKPOINT = 5
+IGNORE_CHECKPOINT = 6
+BUFFER_BUILT = 7
+
+NUM_TAGS = 8
+TAG_NAMES = (
+    "ORDER", "TIMESTAMP", "RNG", "SERIALIZABLE", "TIMER_TRIGGER",
+    "SOURCE_CHECKPOINT", "IGNORE_CHECKPOINT", "BUFFER_BUILT",
+)
+
+# Tags whose effect fires at a target record count during replay
+# (reference AsyncDeterminant subclasses).
+ASYNC_TAGS = frozenset({TIMER_TRIGGER, SOURCE_CHECKPOINT, IGNORE_CHECKPOINT})
+
+# --- row layout -------------------------------------------------------------
+
+NUM_LANES = 8
+LANE_TAG = 0
+LANE_RC = 1
+LANE_P = 2  # first payload lane
+ROW_DTYPE = np.int32
+ROW_BYTES = NUM_LANES * 4
+
+_I32_MASK = 0xFFFFFFFF
+
+
+def split64(v: int) -> Tuple[int, int]:
+    """Split a signed 64-bit int into (hi, lo) signed 32-bit lane values."""
+    u = v & 0xFFFFFFFFFFFFFFFF
+    hi, lo = (u >> 32) & _I32_MASK, u & _I32_MASK
+    return _tosigned(hi), _tosigned(lo)
+
+
+def join64(hi: int, lo: int) -> int:
+    u = ((hi & _I32_MASK) << 32) | (lo & _I32_MASK)
+    return u - (1 << 64) if u >= (1 << 63) else u
+
+
+def _tosigned(u: int) -> int:
+    return u - (1 << 32) if u >= (1 << 31) else u
+
+
+# --- host-side determinant dataclasses --------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Determinant:
+    """Base: host-side view of one packed row."""
+
+    TAG: ClassVar[int] = -1
+
+    def pack(self) -> np.ndarray:
+        row = np.zeros(NUM_LANES, dtype=ROW_DTYPE)
+        row[LANE_TAG] = self.TAG
+        row[LANE_RC] = getattr(self, "record_count", 0)
+        payload = self._payload()
+        row[LANE_P:LANE_P + len(payload)] = np.array(
+            [_tosigned(p & _I32_MASK) for p in payload], dtype=np.int64
+        ).astype(ROW_DTYPE)
+        return row
+
+    def _payload(self) -> Sequence[int]:
+        return ()
+
+    @classmethod
+    def unpack(cls, row: np.ndarray) -> "Determinant":
+        tag = int(row[LANE_TAG])
+        sub = _TAG_TO_CLASS.get(tag)
+        if sub is None:
+            raise ValueError(f"unknown determinant tag {tag}")
+        return sub._from_row(row)
+
+    @classmethod
+    def _from_row(cls, row: np.ndarray) -> "Determinant":
+        raise NotImplementedError
+
+
+@dataclasses.dataclass(frozen=True)
+class OrderDeterminant(Determinant):
+    """Which input channel the next consumed batch came from.
+
+    TPU-first note: the reference logs one ORDER determinant *per buffer*
+    (CausalBufferOrderService.java:112). Here order is logged per consumed
+    *batch* (one superstep input selection), which is the unit of
+    nondeterministic interleaving in a batched dataflow.
+    """
+
+    TAG: ClassVar[int] = ORDER
+    channel: int = 0
+
+    def _payload(self):
+        return (self.channel,)
+
+    @classmethod
+    def _from_row(cls, row):
+        return cls(channel=int(row[LANE_P]))
+
+
+@dataclasses.dataclass(frozen=True)
+class TimestampDeterminant(Determinant):
+    """A wall-clock read (reference CausalTimeService.currentTimeMillis)."""
+
+    TAG: ClassVar[int] = TIMESTAMP
+    timestamp: int = 0
+
+    def _payload(self):
+        return split64(self.timestamp)
+
+    @classmethod
+    def _from_row(cls, row):
+        return cls(timestamp=join64(int(row[LANE_P]), int(row[LANE_P + 1])))
+
+
+@dataclasses.dataclass(frozen=True)
+class RNGDeterminant(Determinant):
+    """A host-side random draw. (Device PRNG is already deterministic via
+    counter-based keys; only host nondeterminism needs logging.)"""
+
+    TAG: ClassVar[int] = RNG
+    value: int = 0
+
+    def _payload(self):
+        return (self.value,)
+
+    @classmethod
+    def _from_row(cls, row):
+        return cls(value=int(row[LANE_P]))
+
+
+@dataclasses.dataclass(frozen=True)
+class SerializableDeterminant(Determinant):
+    """An arbitrary external-service result; bytes live in a sidecar store."""
+
+    TAG: ClassVar[int] = SERIALIZABLE
+    sidecar_key: int = 0
+    length: int = 0
+    crc32: int = 0
+
+    def _payload(self):
+        return (self.sidecar_key, self.length, self.crc32)
+
+    @classmethod
+    def _from_row(cls, row):
+        return cls(sidecar_key=int(row[LANE_P]), length=int(row[LANE_P + 1]),
+                   crc32=int(row[LANE_P + 2]) & _I32_MASK)
+
+
+@dataclasses.dataclass(frozen=True)
+class TimerTriggerDeterminant(Determinant):
+    """A processing-time timer firing, replayed at record_count."""
+
+    TAG: ClassVar[int] = TIMER_TRIGGER
+    record_count: int = 0
+    callback_id: int = 0
+    timestamp: int = 0
+
+    def _payload(self):
+        hi, lo = split64(self.timestamp)
+        return (self.callback_id, hi, lo)
+
+    @classmethod
+    def _from_row(cls, row):
+        return cls(record_count=int(row[LANE_RC]),
+                   callback_id=int(row[LANE_P]),
+                   timestamp=join64(int(row[LANE_P + 1]), int(row[LANE_P + 2])))
+
+
+@dataclasses.dataclass(frozen=True)
+class SourceCheckpointDeterminant(Determinant):
+    """A checkpoint-trigger RPC arrival at a source, replayed at record_count
+    (reference SourceCheckpointDeterminant.java:40-43: recordCount, ckptID,
+    ts, type, storageRef)."""
+
+    TAG: ClassVar[int] = SOURCE_CHECKPOINT
+    record_count: int = 0
+    checkpoint_id: int = 0
+    timestamp: int = 0
+    checkpoint_type: int = 0
+    storage_ref: int = 0
+
+    def _payload(self):
+        chi, clo = split64(self.checkpoint_id)
+        thi, tlo = split64(self.timestamp)
+        return (chi, clo, thi, tlo, self.checkpoint_type, self.storage_ref)
+
+    @classmethod
+    def _from_row(cls, row):
+        p = [int(row[LANE_P + i]) for i in range(6)]
+        return cls(record_count=int(row[LANE_RC]),
+                   checkpoint_id=join64(p[0], p[1]),
+                   timestamp=join64(p[2], p[3]),
+                   checkpoint_type=p[4], storage_ref=p[5])
+
+
+@dataclasses.dataclass(frozen=True)
+class IgnoreCheckpointDeterminant(Determinant):
+    """Skip a checkpoint the failed task never acked
+    (reference IgnoreCheckpointDeterminant.java:32)."""
+
+    TAG: ClassVar[int] = IGNORE_CHECKPOINT
+    record_count: int = 0
+    checkpoint_id: int = 0
+
+    def _payload(self):
+        return split64(self.checkpoint_id)
+
+    @classmethod
+    def _from_row(cls, row):
+        return cls(record_count=int(row[LANE_RC]),
+                   checkpoint_id=join64(int(row[LANE_P]), int(row[LANE_P + 1])))
+
+
+@dataclasses.dataclass(frozen=True)
+class BufferBuiltDeterminant(Determinant):
+    """Output batch cut: exactly how many records went into an emitted batch
+    (reference BufferBuiltDeterminant.java:36 logs numBytes per buffer cut;
+    here the unit is records per emitted batch, which pins the batch boundary
+    for bit-identical output reconstruction)."""
+
+    TAG: ClassVar[int] = BUFFER_BUILT
+    num_records: int = 0
+
+    def _payload(self):
+        return (self.num_records,)
+
+    @classmethod
+    def _from_row(cls, row):
+        return cls(num_records=int(row[LANE_P]))
+
+
+_TAG_TO_CLASS: Dict[int, Type[Determinant]] = {
+    ORDER: OrderDeterminant,
+    TIMESTAMP: TimestampDeterminant,
+    RNG: RNGDeterminant,
+    SERIALIZABLE: SerializableDeterminant,
+    TIMER_TRIGGER: TimerTriggerDeterminant,
+    SOURCE_CHECKPOINT: SourceCheckpointDeterminant,
+    IGNORE_CHECKPOINT: IgnoreCheckpointDeterminant,
+    BUFFER_BUILT: BufferBuiltDeterminant,
+}
+
+
+# --- batch codec (reference SimpleDeterminantEncoder.java:35 equivalent) ----
+
+
+def pack_batch(dets: Sequence[Determinant]) -> np.ndarray:
+    """Pack determinants into an ``int32[n, NUM_LANES]`` array."""
+    if not dets:
+        return np.zeros((0, NUM_LANES), dtype=ROW_DTYPE)
+    return np.stack([d.pack() for d in dets])
+
+
+def unpack_batch(rows: np.ndarray) -> List[Determinant]:
+    return [Determinant.unpack(rows[i]) for i in range(rows.shape[0])]
+
+
+def to_bytes(rows: np.ndarray) -> bytes:
+    """Wire/spill serialization: contiguous little-endian rows."""
+    return np.ascontiguousarray(rows.astype("<i4")).tobytes()
+
+
+def from_bytes(data: bytes) -> np.ndarray:
+    arr = np.frombuffer(data, dtype="<i4")
+    if arr.size % NUM_LANES:
+        raise ValueError(f"byte length {len(data)} is not a whole number of rows")
+    return arr.reshape(-1, NUM_LANES).astype(ROW_DTYPE)
+
+
+# --- sidecar store for SERIALIZABLE payloads --------------------------------
+
+
+class SidecarStore:
+    """Host-side blob store for variable-width SERIALIZABLE payloads.
+
+    Epoch-scoped like the determinant log itself: blobs are tagged with the
+    epoch they were created in and dropped when that epoch is truncated.
+
+    Keys are namespaced by the owning task (``owner`` in the high bits) so
+    blobs replicated between stores during recovery can never collide with
+    locally-allocated keys.
+    """
+
+    OWNER_SHIFT = 20  # 2^20 blobs per owner per truncation window
+
+    def __init__(self, owner: int = 0):
+        if not (0 <= owner < (1 << (31 - self.OWNER_SHIFT))):
+            raise ValueError(f"owner id out of range: {owner}")
+        self.owner = owner
+        self._blobs: Dict[int, Tuple[int, bytes]] = {}
+        self._next_seq = 1
+
+    def put(self, data: bytes, epoch: int) -> SerializableDeterminant:
+        key = (self.owner << self.OWNER_SHIFT) | self._next_seq
+        self._next_seq += 1
+        if self._next_seq >= (1 << self.OWNER_SHIFT):
+            raise RuntimeError("sidecar key space exhausted before truncation")
+        self._blobs[key] = (epoch, data)
+        return SerializableDeterminant(
+            sidecar_key=key, length=len(data), crc32=zlib.crc32(data))
+
+    def get(self, det: SerializableDeterminant) -> bytes:
+        epoch, data = self._blobs[det.sidecar_key]
+        if len(data) != det.length or zlib.crc32(data) != det.crc32:
+            raise ValueError(f"sidecar blob {det.sidecar_key} fails integrity check")
+        return data
+
+    def merge_from(self, other: "SidecarStore") -> None:
+        """Adopt blobs replicated from another store (recovery path).
+
+        Owner-namespaced keys make cross-store collisions impossible unless
+        two stores share an owner id with divergent contents — that is a
+        protocol violation and raises."""
+        for key, (epoch, data) in other._blobs.items():
+            existing = self._blobs.get(key)
+            if existing is not None and existing[1] != data:
+                raise ValueError(
+                    f"sidecar key collision on {key}: divergent contents "
+                    f"(duplicate owner id?)")
+            self._blobs[key] = (epoch, data)
+
+    def truncate(self, oldest_live_epoch: int) -> None:
+        dead = [k for k, (e, _) in self._blobs.items() if e < oldest_live_epoch]
+        for k in dead:
+            del self._blobs[k]
+
+    def __len__(self) -> int:
+        return len(self._blobs)

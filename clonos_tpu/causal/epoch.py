@@ -1,0 +1,119 @@
+"""Epoch tracking.
+
+Capability of the reference's ``EpochTracker``/``EpochTrackerImpl``
+(flink-runtime .../causal/EpochTrackerImpl.java:40 — incRecordCount:84,
+startNewEpoch:94, setRecordCountTarget:111, fireAnyAsyncEvent:118), split
+TPU-natively into:
+
+- :class:`EpochState` — two int32 scalars carried *inside* the jitted step
+  (epoch id, record count since epoch start), manipulated by pure functions
+  so XLA sees straight-line arithmetic, no host chatter; and
+- :class:`EpochTracker` — the host-side control-plane mirror that owns
+  listener registration and async-determinant replay targets (targets only
+  matter between supersteps, never inside the compiled hot loop).
+
+Epoch n = all records between checkpoint barrier n and n+1; a completed
+checkpoint truncates the causal and in-flight logs back to its boundary.
+"""
+
+from __future__ import annotations
+
+import bisect
+import dataclasses
+from typing import Callable, List, NamedTuple, Tuple
+
+import jax.numpy as jnp
+
+from clonos_tpu.causal.determinant import Determinant
+
+
+class EpochState(NamedTuple):
+    """Device-resident epoch scalars (part of every task's step carry)."""
+
+    epoch_id: jnp.ndarray      # int32 scalar
+    record_count: jnp.ndarray  # int32 scalar, records since epoch start
+    total_records: jnp.ndarray # int32 scalar, records since job start
+
+    @staticmethod
+    def initial(epoch_id: int = 0) -> "EpochState":
+        z = jnp.asarray(0, jnp.int32)
+        return EpochState(jnp.asarray(epoch_id, jnp.int32), z, z)
+
+
+def inc_record_count(state: EpochState, n) -> EpochState:
+    n = jnp.asarray(n, jnp.int32)
+    return EpochState(state.epoch_id, state.record_count + n,
+                      state.total_records + n)
+
+
+def start_new_epoch(state: EpochState, new_epoch_id) -> EpochState:
+    return EpochState(jnp.asarray(new_epoch_id, jnp.int32),
+                      jnp.asarray(0, jnp.int32), state.total_records)
+
+
+@dataclasses.dataclass
+class EpochTracker:
+    """Host-side epoch control plane for one task.
+
+    Maintains the listener bus and the async-determinant firing queue used
+    during replay (reference fireAnyAsyncEvent:118: fire each stored async
+    determinant exactly when record_count reaches its recorded target).
+    """
+
+    epoch_id: int = 0
+    record_count: int = 0
+    _epoch_listeners: List[Callable[[int], None]] = dataclasses.field(default_factory=list)
+    _checkpoint_listeners: List[Callable[[int], None]] = dataclasses.field(default_factory=list)
+    # sorted list of (target_record_count, seq, determinant, callback)
+    _targets: List[Tuple[int, int, Determinant, Callable[[Determinant], None]]] = (
+        dataclasses.field(default_factory=list))
+    _seq: int = 0
+
+    def subscribe_epoch_start(self, fn: Callable[[int], None]) -> None:
+        self._epoch_listeners.append(fn)
+
+    def subscribe_checkpoint_complete(self, fn: Callable[[int], None]) -> None:
+        self._checkpoint_listeners.append(fn)
+
+    def start_new_epoch(self, epoch_id: int) -> None:
+        self.epoch_id = epoch_id
+        self.record_count = 0
+        for fn in self._epoch_listeners:
+            fn(epoch_id)
+        # A replay target at record count 0 (first event of the new epoch)
+        # must fire now (reference EpochTrackerImpl.startNewEpoch:94-103).
+        self.fire_due_events()
+
+    def notify_checkpoint_complete(self, checkpoint_id: int) -> None:
+        for fn in self._checkpoint_listeners:
+            fn(checkpoint_id)
+
+    def set_record_count_target(
+        self, target: int, det: Determinant,
+        callback: Callable[[Determinant], None],
+    ) -> None:
+        """Register an async determinant to fire when record_count hits
+        ``target`` (replay path; reference setRecordCountTarget:111)."""
+        if target < self.record_count:
+            raise ValueError(
+                f"target {target} already passed (record_count={self.record_count})")
+        entry = (target, self._seq, det, callback)
+        self._seq += 1
+        # seq is unique, so tuple comparison never reaches the determinant.
+        bisect.insort(self._targets, entry)
+        # Fire immediately if already due (reference setRecordCountTarget:111
+        # fires when recordCount == target at registration).
+        self.fire_due_events()
+
+    def inc_record_count(self, n: int = 1) -> None:
+        self.record_count += n
+        self.fire_due_events()
+
+    def fire_due_events(self) -> None:
+        while self._targets and self._targets[0][0] <= self.record_count:
+            _, _, det, callback = self._targets.pop(0)
+            callback(det)
+
+    @property
+    def pending_targets(self) -> int:
+        return len(self._targets)

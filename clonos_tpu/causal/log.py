@@ -1,0 +1,300 @@
+"""Thread causal log: device-resident determinant ring buffer.
+
+Capability parity with the reference's ``ThreadCausalLogImpl``
+(flink-runtime .../causal/log/thread/ThreadCausalLogImpl.java:51 —
+appendDeterminant:158, processUpstreamDelta:117 (dedup overlapping deltas by
+offset), hasDeltaForConsumer:196, getDeltaForConsumer:249,
+getDeterminants:285, makeDeltaUnsafe:364, notifyCheckpointComplete:398
+(truncation as offset rebase, no copy)) — re-designed for TPU:
+
+- The log is one ``int32[capacity, NUM_LANES]`` ring buffer in HBM plus a
+  handful of int32 scalars, bundled as the :class:`ThreadLogState` pytree.
+- All offsets are *absolute* (monotonic append counts); ring position is
+  ``offset % capacity``. Truncation advances ``tail`` — no copying, exactly
+  the reference's index-rebase trick but free because offsets never move.
+- Every operation is a pure function on the state, so XLA fuses appends into
+  the surrounding step and ``jax.vmap`` batches the same operation over all
+  logs on a device (the stacked-log layout — see :func:`stack_logs`).
+- The JVM version guards epochs with read/write locks
+  (ThreadCausalLogImpl.java:63-70); here there is nothing to lock — appends
+  are data dependencies in a single traced program, ordered by XLA.
+
+Static-shape discipline: appends take a fixed-size padded row buffer plus a
+count; delta extraction returns a fixed-size buffer plus a count. Capacity
+must be a power of two (cheap masking instead of modulo).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from clonos_tpu.causal.determinant import NUM_LANES
+
+
+class ThreadLogState(NamedTuple):
+    """Pytree state of one thread causal log (all device-resident)."""
+
+    rows: jnp.ndarray          # int32[capacity, NUM_LANES] ring storage
+    head: jnp.ndarray          # int32 scalar: absolute append count
+    tail: jnp.ndarray          # int32 scalar: absolute oldest retained offset
+    epoch_starts: jnp.ndarray  # int32[max_epochs]: absolute start offset of
+                               #   epoch e at index e % max_epochs
+    epoch_base: jnp.ndarray    # int32 scalar: oldest retained epoch id
+    latest_epoch: jnp.ndarray  # int32 scalar: newest epoch recorded via
+                               #   start_epoch (for epoch-index overflow
+                               #   detection)
+
+    @property
+    def capacity(self) -> int:
+        return self.rows.shape[0]
+
+    @property
+    def max_epochs(self) -> int:
+        return self.epoch_starts.shape[0]
+
+
+def create(capacity: int, max_epochs: int) -> ThreadLogState:
+    if capacity & (capacity - 1):
+        raise ValueError(f"capacity must be a power of two, got {capacity}")
+    z = jnp.asarray(0, jnp.int32)
+    return ThreadLogState(
+        rows=jnp.zeros((capacity, NUM_LANES), jnp.int32),
+        head=z, tail=z,
+        epoch_starts=jnp.zeros((max_epochs,), jnp.int32),
+        epoch_base=z, latest_epoch=z,
+    )
+
+
+def size(state: ThreadLogState) -> jnp.ndarray:
+    """Live determinants currently retained."""
+    return state.head - state.tail
+
+
+def overflowed(state: ThreadLogState) -> jnp.ndarray:
+    """True if appends have clobbered un-truncated determinants (the JVM
+    analog is the determinant BufferPool running dry)."""
+    return size(state) > state.capacity
+
+
+def epoch_index_overflowed(state: ThreadLogState) -> jnp.ndarray:
+    """True if more than ``max_epochs`` epochs are un-truncated, i.e.
+    ``start_epoch`` has overwritten a live epoch's index slot and a later
+    ``truncate`` could advance ``tail`` past retained determinants. The
+    control plane must check this (and stall epoch rolls / force a
+    checkpoint) before it bites."""
+    return state.latest_epoch - state.epoch_base + 1 > state.max_epochs
+
+
+def near_offset_wrap(state: ThreadLogState, margin: int = 1 << 29) -> jnp.ndarray:
+    """True when absolute int32 offsets approach 2^31 and the control plane
+    should trigger a coordinated :func:`rebase` at the next checkpoint."""
+    return state.head > jnp.asarray((1 << 31) - 1 - margin, jnp.int32)
+
+
+def rebase(state: ThreadLogState, amount) -> ThreadLogState:
+    """Subtract ``amount`` from every absolute offset (head/tail/epoch
+    index). Safe only when all producers and replicas of this log rebase by
+    the same globally-agreed amount (a multiple of capacity, so ring
+    positions are unchanged) at a quiescent point — the checkpoint fence.
+    This is the int32-wrap mitigation for long-running streams."""
+    amount = jnp.asarray(amount, jnp.int32)
+    return state._replace(
+        head=state.head - amount,
+        tail=state.tail - amount,
+        epoch_starts=state.epoch_starts - amount,
+    )
+
+
+def append(state: ThreadLogState, rows: jnp.ndarray, count) -> ThreadLogState:
+    """Append the first ``count`` rows of a padded ``[max_batch, NUM_LANES]``
+    buffer at head (reference appendDeterminant:158, vectorized)."""
+    max_batch = rows.shape[0]
+    count = jnp.asarray(count, jnp.int32)
+    idx = jnp.arange(max_batch, dtype=jnp.int32)
+    pos = (state.head + idx) & (state.capacity - 1)
+    live = idx < count
+    # Masked scatter: positions past `count` write back their current value.
+    current = state.rows[pos]
+    vals = jnp.where(live[:, None], rows, current)
+    new_rows = state.rows.at[pos].set(vals, mode="drop")
+    return state._replace(rows=new_rows, head=state.head + count)
+
+
+def append_one(state: ThreadLogState, row: jnp.ndarray) -> ThreadLogState:
+    """Append a single row (hot path inside a traced step)."""
+    pos = state.head & (state.capacity - 1)
+    return state._replace(rows=state.rows.at[pos].set(row),
+                          head=state.head + 1)
+
+
+def start_epoch(state: ThreadLogState, epoch_id) -> ThreadLogState:
+    """Record the epoch -> offset index entry for a newly started epoch.
+
+    If more than ``max_epochs`` epochs pile up un-truncated this overwrites
+    the oldest live slot — detectable via :func:`epoch_index_overflowed`,
+    which the checkpoint coordinator checks each epoch roll."""
+    e = jnp.asarray(epoch_id, jnp.int32)
+    slot = e % state.max_epochs
+    return state._replace(
+        epoch_starts=state.epoch_starts.at[slot].set(state.head),
+        latest_epoch=jnp.maximum(state.latest_epoch, e))
+
+
+def epoch_start_offset(state: ThreadLogState, epoch_id) -> jnp.ndarray:
+    e = jnp.asarray(epoch_id, jnp.int32)
+    return state.epoch_starts[e % state.max_epochs]
+
+
+def truncate(state: ThreadLogState, completed_epoch) -> ThreadLogState:
+    """Checkpoint ``completed_epoch`` finished: drop determinants of epochs
+    <= completed_epoch (reference notifyCheckpointComplete:398). Pure offset
+    rebase; storage is untouched."""
+    e = jnp.asarray(completed_epoch, jnp.int32)
+    new_tail = epoch_start_offset(state, e + 1)
+    # Never move backwards (late / duplicate notifications are no-ops).
+    new_tail = jnp.maximum(new_tail, state.tail)
+    new_base = jnp.maximum(e + 1, state.epoch_base)
+    return state._replace(tail=new_tail, epoch_base=new_base)
+
+
+def slice_from(
+    state: ThreadLogState, abs_offset, max_out: int
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Gather rows [abs_offset, head) into a fixed-size buffer.
+
+    Returns ``(buf[max_out, NUM_LANES], count, start_offset)`` — the delta
+    triple that is this framework's wire format (reference makeDeltaUnsafe:364
+    zero-copy slice; here a gather that XLA fuses into the consumer).
+    """
+    start = jnp.maximum(jnp.asarray(abs_offset, jnp.int32), state.tail)
+    count = jnp.clip(state.head - start, 0, max_out)
+    idx = jnp.arange(max_out, dtype=jnp.int32)
+    pos = (start + idx) & (state.capacity - 1)
+    buf = jnp.where((idx < count)[:, None], state.rows[pos], 0)
+    return buf, count, start
+
+
+def get_determinants(state: ThreadLogState, from_epoch, max_out: int):
+    """All retained determinants from the start of ``from_epoch``
+    (reference getDeterminants:285 — the replay feed)."""
+    return slice_from(state, epoch_start_offset(state, from_epoch), max_out)
+
+
+def merge_delta(
+    state: ThreadLogState, rows: jnp.ndarray, count, abs_start
+) -> Tuple[ThreadLogState, jnp.ndarray]:
+    """Ingest a replicated delta of another task's log into this replica.
+
+    Dedups by absolute offset exactly like the reference's
+    ``processUpstreamDelta:117``: entries with offset < head are already
+    present and skipped; only the fresh suffix is appended.
+
+    Returns ``(new_state, gap)``. ``gap`` is True when ``abs_start > head``
+    (a preceding delta was lost, e.g. across a reconnect): nothing is
+    appended — absorbing the delta would record rows under wrong offsets —
+    and the caller must request a full re-send from ``head``.
+    """
+    max_batch = rows.shape[0]
+    count = jnp.asarray(count, jnp.int32)
+    abs_start = jnp.asarray(abs_start, jnp.int32)
+    gap = abs_start > state.head
+    skip = jnp.clip(state.head - abs_start, 0, count)
+    fresh = jnp.where(gap, 0, count - skip)
+    idx = jnp.arange(max_batch, dtype=jnp.int32)
+    shifted = jnp.where(idx + skip < max_batch, idx + skip, 0)
+    fresh_rows = rows[shifted]
+    return append(state, fresh_rows, fresh), gap
+
+
+def sync_epoch_index(state: ThreadLogState, epoch_id) -> ThreadLogState:
+    """Replica-side epoch bookkeeping: note that ``epoch_id`` starts at the
+    replica's current head (called when the owner signals an epoch roll)."""
+    return start_epoch(state, epoch_id)
+
+
+# --- stacked-log layout -----------------------------------------------------
+#
+# A device holds many thread logs (its own main-thread + per-subpartition
+# logs, plus replicas of upstream logs within sharing depth). Stacking them
+# as one [L, capacity, NUM_LANES] pytree and vmapping the ops turns "for each
+# log: append/merge/slice" into single fused XLA ops — the TPU answer to the
+# reference's per-log object graph (JobCausalLogImpl's flat + hierarchical
+# maps).
+
+v_append = jax.vmap(append)
+v_merge_delta = jax.vmap(merge_delta)
+v_slice_from = jax.vmap(slice_from, in_axes=(0, 0, None))
+v_truncate = jax.vmap(truncate, in_axes=(0, None))
+v_start_epoch = jax.vmap(start_epoch, in_axes=(0, None))
+
+
+def stack_logs(states) -> ThreadLogState:
+    """Stack per-log states into one vmappable stacked state."""
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *states)
+
+
+def unstack_logs(stacked: ThreadLogState):
+    n = stacked.head.shape[0]
+    return [jax.tree_util.tree_map(lambda x: x[i], stacked) for i in range(n)]
+
+
+# --- host-side convenience wrapper (tests / control plane) ------------------
+
+
+class ThreadCausalLog:
+    """Thin OO wrapper over the functional core, for host-side use.
+
+    The executor never uses this in the hot path — there, log states live in
+    the jitted step carry. This wrapper backs unit tests and the recovery
+    control plane's host-side log manipulation.
+    """
+
+    def __init__(self, capacity: int = 1 << 12, max_epochs: int = 64):
+        self.state = create(capacity, max_epochs)
+        self._append1 = jax.jit(append_one)
+        self._append = jax.jit(append, static_argnums=())
+        self._truncate = jax.jit(truncate)
+        self._start_epoch = jax.jit(start_epoch)
+        self._merge = jax.jit(merge_delta)
+
+    def append_rows(self, rows: np.ndarray) -> None:
+        if rows.ndim != 2 or rows.shape[1] != NUM_LANES:
+            raise ValueError(f"expected [n, {NUM_LANES}] rows, got {rows.shape}")
+        self.state = self._append(self.state, jnp.asarray(rows, jnp.int32),
+                                  rows.shape[0])
+
+    def start_epoch(self, epoch_id: int) -> None:
+        self.state = self._start_epoch(self.state, epoch_id)
+
+    def notify_checkpoint_complete(self, epoch_id: int) -> None:
+        self.state = self._truncate(self.state, epoch_id)
+
+    def merge_delta(self, rows: np.ndarray, abs_start: int) -> bool:
+        """Returns True on success; False when a gap was detected (nothing
+        merged — request a full re-send from ``self.head``)."""
+        self.state, gap = self._merge(self.state, jnp.asarray(rows, jnp.int32),
+                                      rows.shape[0], abs_start)
+        return not bool(gap)
+
+    def delta_for_consumer(self, consumer_offset: int, max_out: int):
+        buf, count, start = slice_from(self.state, consumer_offset, max_out)
+        return np.asarray(buf)[: int(count)], int(start)
+
+    def determinants_from_epoch(self, epoch: int, max_out: int) -> np.ndarray:
+        buf, count, _ = get_determinants(self.state, epoch, max_out)
+        return np.asarray(buf)[: int(count)]
+
+    @property
+    def head(self) -> int:
+        return int(self.state.head)
+
+    @property
+    def tail(self) -> int:
+        return int(self.state.tail)
+
+    def __len__(self) -> int:
+        return int(size(self.state))

@@ -1,0 +1,4 @@
+from clonos_tpu.config.options import ConfigOption, Configuration
+from clonos_tpu.config import defaults
+
+__all__ = ["ConfigOption", "Configuration", "defaults"]

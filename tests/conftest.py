@@ -1,0 +1,15 @@
+"""Test harness: run on a virtual 8-device CPU mesh.
+
+The reference tests multi-node behavior without a cluster via the in-JVM
+MiniCluster (flink-runtime .../minicluster/MiniCluster.java:108). The JAX
+analog is forcing the host platform to expose 8 virtual devices, so every
+sharding/collective path is exercised single-process.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()

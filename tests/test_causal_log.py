@@ -1,0 +1,176 @@
+"""ThreadCausalLog ring-buffer tests: append, epoch index, truncation,
+delta slicing, upstream-delta dedup (the coverage SURVEY §4 calls for)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from clonos_tpu.causal import determinant as det
+from clonos_tpu.causal import log as clog
+
+
+def rows_of(values, tag=det.RNG):
+    return det.pack_batch([det.RNGDeterminant(value=v) for v in values])
+
+
+def test_append_and_read_back():
+    tl = clog.ThreadCausalLog(capacity=64, max_epochs=8)
+    tl.start_epoch(0)
+    tl.append_rows(rows_of([10, 11, 12]))
+    assert tl.head == 3 and tl.tail == 0 and len(tl) == 3
+    got = tl.determinants_from_epoch(0, max_out=16)
+    assert [d.value for d in det.unpack_batch(got)] == [10, 11, 12]
+
+
+def test_epoch_truncation_rebases_tail():
+    tl = clog.ThreadCausalLog(capacity=64, max_epochs=8)
+    tl.start_epoch(0)
+    tl.append_rows(rows_of([1, 2]))
+    tl.start_epoch(1)
+    tl.append_rows(rows_of([3, 4, 5]))
+    tl.start_epoch(2)
+    tl.append_rows(rows_of([6]))
+    assert len(tl) == 6
+    tl.notify_checkpoint_complete(0)  # drops epoch 0
+    assert tl.tail == 2 and len(tl) == 4
+    got = tl.determinants_from_epoch(1, max_out=16)
+    assert [d.value for d in det.unpack_batch(got)] == [3, 4, 5, 6]
+    # duplicate / late notification is a no-op
+    tl.notify_checkpoint_complete(0)
+    assert tl.tail == 2
+
+
+def test_ring_wraparound():
+    tl = clog.ThreadCausalLog(capacity=8, max_epochs=4)
+    tl.start_epoch(0)
+    tl.append_rows(rows_of(list(range(6))))
+    tl.start_epoch(1)
+    tl.notify_checkpoint_complete(0)  # tail -> 6
+    tl.append_rows(rows_of(list(range(100, 107))))  # head -> 13, wraps
+    assert tl.head == 13 and tl.tail == 6
+    got = tl.determinants_from_epoch(1, max_out=8)
+    assert [d.value for d in det.unpack_batch(got)] == list(range(100, 107))
+    assert not bool(clog.overflowed(tl.state))
+
+
+def test_overflow_detection():
+    tl = clog.ThreadCausalLog(capacity=8, max_epochs=4)
+    tl.start_epoch(0)
+    tl.append_rows(rows_of(list(range(9))))
+    assert bool(clog.overflowed(tl.state))
+
+
+def test_delta_for_consumer_and_offsets():
+    tl = clog.ThreadCausalLog(capacity=64, max_epochs=8)
+    tl.start_epoch(0)
+    tl.append_rows(rows_of([1, 2, 3]))
+    d1, start1 = tl.delta_for_consumer(0, max_out=8)
+    assert start1 == 0 and d1.shape[0] == 3
+    tl.append_rows(rows_of([4, 5]))
+    d2, start2 = tl.delta_for_consumer(3, max_out=8)
+    assert start2 == 3
+    assert [d.value for d in det.unpack_batch(d2)] == [4, 5]
+
+
+def test_merge_delta_dedups_by_offset():
+    # replica ingests overlapping deltas, must dedup like
+    # processUpstreamDelta:117
+    replica = clog.ThreadCausalLog(capacity=64, max_epochs=8)
+    assert replica.merge_delta(rows_of([1, 2, 3]), abs_start=0)
+    assert replica.head == 3
+    # overlapping delta: offsets 1..4 — only 3,4 are fresh... (values 2,3,9)
+    assert replica.merge_delta(rows_of([2, 3, 9]), abs_start=1)
+    assert replica.head == 4
+    got = replica.determinants_from_epoch(0, max_out=16)
+    assert [d.value for d in det.unpack_batch(got)] == [1, 2, 3, 9]
+    # fully-stale delta is a no-op
+    assert replica.merge_delta(rows_of([1, 2]), abs_start=0)
+    assert replica.head == 4
+
+
+def test_merge_delta_gap_rejected():
+    """A gapped delta (abs_start > head) is rejected, not absorbed at wrong
+    offsets; the caller re-requests from head."""
+    replica = clog.ThreadCausalLog(capacity=64, max_epochs=8)
+    assert replica.merge_delta(rows_of([1, 2]), abs_start=0)
+    ok = replica.merge_delta(rows_of([9, 10]), abs_start=5)  # gap: lost 2..4
+    assert not ok
+    assert replica.head == 2  # nothing merged
+    # full re-send from head succeeds
+    assert replica.merge_delta(rows_of([3, 4, 5, 9, 10]), abs_start=2)
+    assert replica.head == 7
+
+
+def test_epoch_index_overflow_detection():
+    tl = clog.ThreadCausalLog(capacity=64, max_epochs=4)
+    for e in range(4):
+        tl.start_epoch(e)
+        tl.append_rows(rows_of([e]))
+    assert not bool(clog.epoch_index_overflowed(tl.state))
+    tl.start_epoch(4)  # 5 live epochs, slot of epoch 0 overwritten
+    assert bool(clog.epoch_index_overflowed(tl.state))
+    tl.notify_checkpoint_complete(0)
+    assert not bool(clog.epoch_index_overflowed(tl.state))
+
+
+def test_rebase_preserves_content():
+    tl = clog.ThreadCausalLog(capacity=8, max_epochs=8)
+    tl.start_epoch(0)
+    tl.append_rows(rows_of([0, 1, 2, 3, 4]))       # head 5
+    tl.start_epoch(1)
+    tl.notify_checkpoint_complete(0)               # tail 5
+    tl.append_rows(rows_of([10, 11, 12, 13]))      # head 9
+    tl.start_epoch(2)                              # starts at 9
+    tl.append_rows(rows_of([20, 21]))              # head 11
+    tl.notify_checkpoint_complete(1)               # tail 9
+    before = det.unpack_batch(tl.determinants_from_epoch(2, max_out=8))
+    assert [d.value for d in before] == [20, 21]
+    # coordinated rebase: amount is a multiple of capacity, <= tail
+    tl.state = clog.rebase(tl.state, 8)
+    assert tl.tail == 1 and tl.head == 3
+    after = det.unpack_batch(tl.determinants_from_epoch(2, max_out=8))
+    assert before == after
+    assert not bool(clog.near_offset_wrap(tl.state))
+
+
+def test_slice_from_respects_tail():
+    tl = clog.ThreadCausalLog(capacity=16, max_epochs=4)
+    tl.start_epoch(0)
+    tl.append_rows(rows_of([1, 2]))
+    tl.start_epoch(1)
+    tl.append_rows(rows_of([3]))
+    tl.notify_checkpoint_complete(0)
+    buf, count, start = clog.slice_from(tl.state, 0, 8)
+    # request below tail gets clamped to tail
+    assert int(start) == 2 and int(count) == 1
+
+
+def test_stacked_vmap_append_and_slice():
+    logs = [clog.create(32, 4) for _ in range(4)]
+    stacked = clog.stack_logs(logs)
+    batch = jnp.stack([jnp.asarray(rows_of([i, i + 1]), jnp.int32)
+                       for i in range(4)])
+    counts = jnp.array([2, 1, 2, 0], jnp.int32)
+    stacked = clog.v_append(stacked, batch, counts)
+    np.testing.assert_array_equal(np.asarray(stacked.head), [2, 1, 2, 0])
+    bufs, cnts, starts = clog.v_slice_from(
+        stacked, jnp.zeros(4, jnp.int32), 8)
+    np.testing.assert_array_equal(np.asarray(cnts), [2, 1, 2, 0])
+    per = clog.unstack_logs(stacked)
+    assert int(per[1].head) == 1
+
+
+def test_append_under_jit_scan():
+    """Appends inside lax.scan (the real hot-path shape)."""
+    state = clog.create(64, 8)
+
+    def step(s, v):
+        row = jnp.zeros((det.NUM_LANES,), jnp.int32)
+        row = row.at[det.LANE_TAG].set(det.RNG).at[det.LANE_P].set(v)
+        return clog.append_one(s, row), None
+
+    state, _ = jax.jit(lambda s: jax.lax.scan(step, s, jnp.arange(10, dtype=jnp.int32)))(state)
+    assert int(state.head) == 10
+    buf, count, _ = clog.slice_from(state, 0, 16)
+    assert [d.value for d in det.unpack_batch(np.asarray(buf)[:int(count)])] == list(range(10))

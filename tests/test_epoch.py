@@ -1,0 +1,96 @@
+"""Epoch tracker tests: device scalars + host async-determinant firing
+(reference EpochTrackerImpl.java:40)."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from clonos_tpu.causal import epoch as ep
+from clonos_tpu.causal.determinant import TimerTriggerDeterminant
+
+
+def test_device_epoch_state_under_jit():
+    s = ep.EpochState.initial()
+
+    @jax.jit
+    def f(s):
+        s = ep.inc_record_count(s, 5)
+        s = ep.inc_record_count(s, 3)
+        s = ep.start_new_epoch(s, 1)
+        s = ep.inc_record_count(s, 2)
+        return s
+
+    s = f(s)
+    assert int(s.epoch_id) == 1
+    assert int(s.record_count) == 2
+    assert int(s.total_records) == 10
+
+
+def test_host_tracker_listeners():
+    t = ep.EpochTracker()
+    seen = []
+    t.subscribe_epoch_start(seen.append)
+    t.subscribe_checkpoint_complete(lambda c: seen.append(("ckpt", c)))
+    t.start_new_epoch(1)
+    t.notify_checkpoint_complete(0)
+    assert seen == [1, ("ckpt", 0)]
+    assert t.record_count == 0
+
+
+def test_async_determinant_fires_at_target():
+    t = ep.EpochTracker()
+    fired = []
+    d5 = TimerTriggerDeterminant(record_count=5, callback_id=1)
+    d2 = TimerTriggerDeterminant(record_count=2, callback_id=2)
+    t.set_record_count_target(5, d5, fired.append)
+    t.set_record_count_target(2, d2, fired.append)
+    t.inc_record_count(1)
+    assert fired == []
+    t.inc_record_count(1)  # rc=2
+    assert fired == [d2]
+    t.inc_record_count(4)  # rc=6, passes 5
+    assert fired == [d2, d5]
+    assert t.pending_targets == 0
+
+
+def test_same_target_fifo_order():
+    t = ep.EpochTracker()
+    fired = []
+    a = TimerTriggerDeterminant(record_count=3, callback_id=1)
+    b = TimerTriggerDeterminant(record_count=3, callback_id=2)
+    t.set_record_count_target(3, a, fired.append)
+    t.set_record_count_target(3, b, fired.append)
+    t.inc_record_count(3)
+    assert fired == [a, b]
+
+
+def test_target_in_past_rejected():
+    t = ep.EpochTracker()
+    t.inc_record_count(10)
+    with pytest.raises(ValueError):
+        t.set_record_count_target(
+            5, TimerTriggerDeterminant(record_count=5), lambda d: None)
+
+
+def test_target_at_current_count_fires_immediately():
+    """Reference setRecordCountTarget:111 fires when recordCount == target
+    at registration time."""
+    t = ep.EpochTracker()
+    t.inc_record_count(5)
+    fired = []
+    d = TimerTriggerDeterminant(record_count=5, callback_id=9)
+    t.set_record_count_target(5, d, fired.append)
+    assert fired == [d]
+
+
+def test_target_zero_fires_on_epoch_start():
+    """A determinant recorded as the first event of an epoch must fire when
+    the epoch starts (record_count resets to 0)."""
+    t = ep.EpochTracker()
+    t.inc_record_count(3)
+    fired = []
+    # registered during replay setup for the *next* epoch
+    t.start_new_epoch(1)
+    d = TimerTriggerDeterminant(record_count=0, callback_id=1)
+    t.set_record_count_target(0, d, fired.append)
+    assert fired == [d]

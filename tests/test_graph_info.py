@@ -1,0 +1,68 @@
+"""Vertex graph info: distance computation and sharing-depth masks
+(reference CausalGraphUtils.computeDistances:106,
+JobCausalLogImpl.respondToDeterminantRequest:192 depth cut)."""
+
+import numpy as np
+
+from clonos_tpu.graph.vertex_info import (
+    UNREACHABLE, CausalLogID, VertexGraphInformation, compute_distances)
+
+
+def diamond():
+    # 0 -> 1 -> 3, 0 -> 2 -> 3
+    return 4, [(0, 1), (0, 2), (1, 3), (2, 3)]
+
+
+def test_distances_diamond():
+    n, edges = diamond()
+    d = compute_distances(n, edges)
+    assert d[0, 3] == 2 and d[0, 1] == 1 and d[1, 3] == 1
+    assert d[3, 0] == UNREACHABLE  # directed
+    assert d[1, 2] == UNREACHABLE
+    assert (np.diag(d) == 0).all()
+
+
+def test_upstream_downstream():
+    n, edges = diamond()
+    info = VertexGraphInformation(vertex=3, num_vertices=n,
+                                  edges=tuple(edges), parallelism=(1, 2, 2, 1))
+    assert info.upstream == (1, 2)
+    assert info.downstream == ()
+
+
+def test_logs_to_replicate_depth():
+    n, edges = diamond()
+    v3 = VertexGraphInformation(3, n, tuple(edges), (1, 1, 1, 1))
+    assert v3.logs_to_replicate(sharing_depth=1) == frozenset({1, 2})
+    assert v3.logs_to_replicate(sharing_depth=2) == frozenset({0, 1, 2})
+    assert v3.logs_to_replicate(sharing_depth=-1) == frozenset({0, 1, 2})
+    v1 = VertexGraphInformation(1, n, tuple(edges), (1, 1, 1, 1))
+    assert v1.logs_to_replicate(sharing_depth=1) == frozenset({0})
+
+
+def test_sharing_mask():
+    n, edges = diamond()
+    info = VertexGraphInformation(0, n, tuple(edges), (1, 1, 1, 1))
+    m1 = info.sharing_mask(sharing_depth=1)
+    # owner 0 replicated at holders 1,2 (distance 1) but not 3 (distance 2)
+    assert m1[0, 1] and m1[0, 2] and not m1[0, 3]
+    assert m1[0, 0] and m1[3, 3]  # self always
+    mfull = info.sharing_mask(sharing_depth=-1)
+    assert mfull[0, 3]
+    assert not mfull[3, 0]  # never replicate upstream
+
+
+def test_chain_depth_cut():
+    # 0 -> 1 -> 2 -> 3 -> 4
+    n, edges = 5, [(i, i + 1) for i in range(4)]
+    info = VertexGraphInformation(4, n, tuple(edges), (1,) * 5)
+    assert info.logs_to_replicate(2) == frozenset({2, 3})
+    assert info.logs_to_replicate(-1) == frozenset({0, 1, 2, 3})
+
+
+def test_causal_log_id():
+    main = CausalLogID(vertex=2, subtask=1)
+    assert main.is_main_thread()
+    sp = main.for_subpartition(3)
+    assert not sp.is_main_thread() and sp.subpartition == 3
+    assert sorted([sp, main]) == [main, sp]
