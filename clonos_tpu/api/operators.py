@@ -1,0 +1,219 @@
+"""Operator library: pure per-superstep batch transforms.
+
+Capability analog of the reference's operator layer
+(flink-streaming-java .../api/operators/AbstractStreamOperator.java,
+StreamMap/StreamFilter, windowing/WindowOperator.java, StreamSource) —
+re-imagined for TPU: an operator is a pair of pure functions
+
+    init_state(parallelism)            -> state pytree, leading dim P
+    process(state, batch, ctx)         -> (state, out_batch)
+
+applied to a whole ``RecordBatch[P, B]`` per superstep. No per-record user
+code: transforms are jnp expressions, keyed aggregation is scatter-add into
+dense key tables, and windows fire on causal time carried in the step
+context. Everything traces into one XLA program.
+
+Time discipline (TPU-first): operators never read a clock. The current
+processing time is a step *input* (``OpContext.time``) produced by the
+causal time service — recorded as a TIMESTAMP determinant on the live path
+and replayed from the log during recovery (reference
+CausalTimeService.java:48-67). This makes every operator deterministic given
+(state, batch, ctx).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from clonos_tpu.api.records import RecordBatch, empty, zero_invalid
+from clonos_tpu.parallel import routing
+
+
+class OpContext(NamedTuple):
+    """Per-superstep inputs an operator may consume. All values are device
+    scalars (or [P] vectors) fed by the executor — never host reads."""
+
+    time: jnp.ndarray        # int32 scalar: causal processing time
+    epoch: jnp.ndarray       # int32 scalar: current epoch id
+    step: jnp.ndarray        # int32 scalar: superstep index within epoch
+    rng_bits: jnp.ndarray    # int32 scalar: causal host-RNG draw for this step
+    subtask: jnp.ndarray     # int32[P]: subtask indices (for vmapped ops)
+
+
+class Operator:
+    """Base operator. Subclasses override ``init_state``/``process``."""
+
+    #: output batch capacity per subtask per superstep; None = same as input.
+    out_capacity: Optional[int] = None
+
+    def init_state(self, parallelism: int) -> Any:
+        return ()
+
+    def process(self, state: Any, batch: RecordBatch,
+                ctx: OpContext) -> Tuple[Any, RecordBatch]:
+        raise NotImplementedError
+
+
+@dataclasses.dataclass
+class MapOperator(Operator):
+    """Elementwise transform: fn(keys, values, timestamps) -> same triple.
+    (StreamMap equivalent; fn is a traced jnp expression, not per-record.)"""
+
+    fn: Callable[[jnp.ndarray, jnp.ndarray, jnp.ndarray],
+                 Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]]
+
+    def process(self, state, batch, ctx):
+        k, v, t = self.fn(batch.keys, batch.values, batch.timestamps)
+        return state, zero_invalid(RecordBatch(k, v, t, batch.valid))
+
+
+@dataclasses.dataclass
+class FilterOperator(Operator):
+    """Keep records where pred(keys, values, timestamps) — mask update only;
+    compaction happens at the next exchange (StreamFilter equivalent)."""
+
+    pred: Callable[[jnp.ndarray, jnp.ndarray, jnp.ndarray], jnp.ndarray]
+
+    def process(self, state, batch, ctx):
+        keep = batch.valid & self.pred(batch.keys, batch.values, batch.timestamps)
+        return state, zero_invalid(batch._replace(valid=keep))
+
+
+@dataclasses.dataclass
+class SyntheticSource(Operator):
+    """On-device record generator (benchmark source; StreamSource analog).
+
+    Emits ``batch_size`` records per superstep per subtask with keys drawn
+    from ``[0, vocab)`` by a counter hash — deterministic given the carried
+    sequence counter, so replay regenerates identical data without logging
+    the payloads (the in-flight log covers the *downstream* loss case).
+    """
+
+    vocab: int
+    batch_size: int
+    rate_limit: Optional[int] = None  # records/superstep cap (None = full)
+
+    @property
+    def out_capacity(self):  # type: ignore[override]
+        return self.batch_size
+
+    def init_state(self, parallelism: int):
+        return {"seq": jnp.zeros((parallelism,), jnp.int32)}
+
+    #: key-mix stride; must exceed any parallelism so (seq, subtask) pairs
+    #: stay unique — and must NOT depend on the state's leading dim, which
+    #: is 1 when a lone subtask is being replayed after a failure.
+    SUBTASK_STRIDE = 1 << 10
+
+    def process(self, state, batch, ctx):
+        p = state["seq"].shape[0]
+        b = self.batch_size
+        lane = jnp.arange(b, dtype=jnp.int32)
+        seq = state["seq"][:, None] + lane[None, :]              # [P, B]
+        mix = seq * self.SUBTASK_STRIDE + ctx.subtask[:, None]   # global unique
+        keys = (routing.hash32(mix) % jnp.uint32(self.vocab)).astype(jnp.int32)
+        n = b if self.rate_limit is None else min(b, self.rate_limit)
+        valid = jnp.broadcast_to(lane < n, (p, b))
+        ts = jnp.broadcast_to(ctx.time, (p, b)).astype(jnp.int32)
+        out = zero_invalid(RecordBatch(keys, jnp.ones((p, b), jnp.int32), ts, valid))
+        return {"seq": state["seq"] + n}, out
+
+
+@dataclasses.dataclass
+class KeyedReduceOperator(Operator):
+    """Running keyed reduce over a dense key table (keyed-state analog of the
+    reference's HeapKeyedStateBackend ValueState + ReduceFunction).
+
+    State is ``acc[P, num_keys]``; each subtask only ever sees keys routed to
+    it by the upstream HASH exchange, so tables never conflict. Emits the
+    updated running value for every input record (Flink reduce semantics).
+    """
+
+    num_keys: int
+    reduce_fn: Callable[[jnp.ndarray, jnp.ndarray], jnp.ndarray] = jnp.add
+    init_value: int = 0
+
+    def init_state(self, parallelism: int):
+        return {"acc": jnp.full((parallelism, self.num_keys), self.init_value,
+                                jnp.int32)}
+
+    def process(self, state, batch, ctx):
+        def one(acc, b: RecordBatch):
+            # Sequential fold per slot is wrong for non-commutative fns under
+            # scatter; restrict to associative+commutative reduce_fn (doc'd).
+            contrib = jnp.zeros_like(acc).at[b.keys].add(
+                jnp.where(b.valid, b.values, 0), mode="drop")
+            touched = jnp.zeros(acc.shape, jnp.bool_).at[b.keys].set(
+                b.valid, mode="drop")
+            new_acc = jnp.where(touched, self.reduce_fn(acc, contrib), acc)
+            out_vals = jnp.where(b.valid, new_acc[b.keys], 0)
+            return new_acc, zero_invalid(b._replace(values=out_vals))
+        new_acc, out = jax.vmap(one)(state["acc"], batch)
+        return {"acc": new_acc}, out
+
+
+@dataclasses.dataclass
+class TumblingWindowCountOperator(Operator):
+    """Tumbling processing-time windowed count/sum per key
+    (WindowOperator + aggregate equivalent; the SocketWindowWordCount shape).
+
+    ``window_size`` is in causal-time units. State: dense ``acc[P, K]`` and
+    the current window id per subtask. When ``ctx.time`` crosses a window
+    boundary, emits one record per key with a nonzero accumulator
+    (key, aggregate, window_end_time) and resets. Emission capacity is
+    ``num_keys`` (dense scan of the table — static shape).
+    """
+
+    num_keys: int
+    window_size: int
+
+    @property
+    def out_capacity(self):  # type: ignore[override]
+        return self.num_keys
+
+    def init_state(self, parallelism: int):
+        return {
+            "acc": jnp.zeros((parallelism, self.num_keys), jnp.int32),
+            "window": jnp.zeros((parallelism,), jnp.int32),
+        }
+
+    def process(self, state, batch, ctx):
+        w_now = (ctx.time // self.window_size).astype(jnp.int32)
+
+        def one(acc, window, b: RecordBatch):
+            fire = w_now > window
+            window_end = (window + 1) * self.window_size
+            keys = jnp.arange(self.num_keys, dtype=jnp.int32)
+            out = RecordBatch(
+                keys=keys,
+                values=acc,
+                timestamps=jnp.full((self.num_keys,), 1, jnp.int32) * window_end,
+                valid=fire & (acc != 0),
+            )
+            acc = jnp.where(fire, 0, acc)
+            # Accumulate this superstep's records into the (possibly fresh)
+            # window.
+            acc = acc.at[b.keys].add(jnp.where(b.valid, b.values, 0), mode="drop")
+            window = jnp.where(fire, w_now, window)
+            return acc, window, zero_invalid(out)
+
+        acc, window, out = jax.vmap(one)(state["acc"], state["window"], batch)
+        return {"acc": acc, "window": window}, out
+
+
+@dataclasses.dataclass
+class SinkOperator(Operator):
+    """Terminal operator: passes its input through as the job's visible
+    output (the executor surfaces it to the host) and counts emissions
+    (DiscardingSink/collect-sink analog)."""
+
+    def init_state(self, parallelism: int):
+        return {"emitted": jnp.zeros((parallelism,), jnp.int32)}
+
+    def process(self, state, batch, ctx):
+        return ({"emitted": state["emitted"] + batch.count()},
+                zero_invalid(batch))

@@ -98,6 +98,17 @@ class Determinant:
         row[LANE_TAG] = self.TAG
         row[LANE_RC] = getattr(self, "record_count", 0)
         payload = self._payload()
+        for p in payload:
+            # Single-lane values must fit 32 bits (signed range, or the
+            # unsigned range for masked fields like crc32). Silent masking
+            # here would corrupt the log and make replay diverge from the
+            # original run undetected — fail loudly instead. 64-bit fields
+            # (timestamps, checkpoint ids, sidecar keys) are split across
+            # two lanes by their _payload() via split64.
+            if not (-(1 << 31) <= p < (1 << 32)):
+                raise ValueError(
+                    f"{type(self).__name__} payload value {p} does not fit "
+                    f"a 32-bit lane")
         row[LANE_P:LANE_P + len(payload)] = np.array(
             [_tosigned(p & _I32_MASK) for p in payload], dtype=np.int64
         ).astype(ROW_DTYPE)
@@ -173,7 +184,9 @@ class RNGDeterminant(Determinant):
 
 @dataclasses.dataclass(frozen=True)
 class SerializableDeterminant(Determinant):
-    """An arbitrary external-service result; bytes live in a sidecar store."""
+    """An arbitrary external-service result; bytes live in a sidecar store.
+    The 64-bit sidecar key spans two lanes so long-running jobs never
+    exhaust the key space."""
 
     TAG: ClassVar[int] = SERIALIZABLE
     sidecar_key: int = 0
@@ -181,12 +194,14 @@ class SerializableDeterminant(Determinant):
     crc32: int = 0
 
     def _payload(self):
-        return (self.sidecar_key, self.length, self.crc32)
+        khi, klo = split64(self.sidecar_key)
+        return (khi, klo, self.length, self.crc32)
 
     @classmethod
     def _from_row(cls, row):
-        return cls(sidecar_key=int(row[LANE_P]), length=int(row[LANE_P + 1]),
-                   crc32=int(row[LANE_P + 2]) & _I32_MASK)
+        return cls(sidecar_key=join64(int(row[LANE_P]), int(row[LANE_P + 1])),
+                   length=int(row[LANE_P + 2]),
+                   crc32=int(row[LANE_P + 3]) & _I32_MASK)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -321,23 +336,25 @@ class SidecarStore:
 
     Keys are namespaced by the owning task (``owner`` in the high bits) so
     blobs replicated between stores during recovery can never collide with
-    locally-allocated keys.
+    locally-allocated keys. Keys are 64-bit (two log lanes): 2^40 blobs per
+    owner over the job's lifetime — sequence numbers are never reused, so a
+    key can never alias a stale replicated blob.
     """
 
-    OWNER_SHIFT = 20  # 2^20 blobs per owner per truncation window
+    OWNER_SHIFT = 40
 
     def __init__(self, owner: int = 0):
-        if not (0 <= owner < (1 << (31 - self.OWNER_SHIFT))):
+        if not (0 <= owner < (1 << (63 - self.OWNER_SHIFT))):
             raise ValueError(f"owner id out of range: {owner}")
         self.owner = owner
         self._blobs: Dict[int, Tuple[int, bytes]] = {}
         self._next_seq = 1
 
     def put(self, data: bytes, epoch: int) -> SerializableDeterminant:
+        if self._next_seq >= (1 << self.OWNER_SHIFT):
+            raise RuntimeError("sidecar key space exhausted")
         key = (self.owner << self.OWNER_SHIFT) | self._next_seq
         self._next_seq += 1
-        if self._next_seq >= (1 << self.OWNER_SHIFT):
-            raise RuntimeError("sidecar key space exhausted before truncation")
         self._blobs[key] = (epoch, data)
         return SerializableDeterminant(
             sidecar_key=key, length=len(data), crc32=zlib.crc32(data))

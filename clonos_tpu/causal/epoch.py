@@ -20,7 +20,7 @@ from __future__ import annotations
 
 import bisect
 import dataclasses
-from typing import Callable, List, NamedTuple, Tuple
+from typing import Callable, List, NamedTuple, Optional, Tuple
 
 import jax.numpy as jnp
 
@@ -51,6 +51,21 @@ def start_new_epoch(state: EpochState, new_epoch_id) -> EpochState:
                       jnp.asarray(0, jnp.int32), state.total_records)
 
 
+def total_records_near_wrap(state: EpochState,
+                            margin: int = 1 << 29) -> jnp.ndarray:
+    """True when the int32 job-lifetime record counter approaches 2^31; the
+    control plane rebases it at a checkpoint fence (same int32-wrap
+    discipline as log offsets, causal/log.py near_offset_wrap)."""
+    return state.total_records > jnp.asarray((1 << 31) - 1 - margin,
+                                             jnp.int32)
+
+
+def rebase_total_records(state: EpochState, amount) -> EpochState:
+    """Subtract a globally-agreed amount at a quiescent fence."""
+    return state._replace(
+        total_records=state.total_records - jnp.asarray(amount, jnp.int32))
+
+
 @dataclasses.dataclass
 class EpochTracker:
     """Host-side epoch control plane for one task.
@@ -64,8 +79,8 @@ class EpochTracker:
     record_count: int = 0
     _epoch_listeners: List[Callable[[int], None]] = dataclasses.field(default_factory=list)
     _checkpoint_listeners: List[Callable[[int], None]] = dataclasses.field(default_factory=list)
-    # sorted list of (target_record_count, seq, determinant, callback)
-    _targets: List[Tuple[int, int, Determinant, Callable[[Determinant], None]]] = (
+    # sorted list of (epoch, target_record_count, seq, determinant, callback)
+    _targets: List[Tuple[int, int, int, Determinant, Callable[[Determinant], None]]] = (
         dataclasses.field(default_factory=list))
     _seq: int = 0
 
@@ -91,13 +106,21 @@ class EpochTracker:
     def set_record_count_target(
         self, target: int, det: Determinant,
         callback: Callable[[Determinant], None],
+        epoch: Optional[int] = None,
     ) -> None:
-        """Register an async determinant to fire when record_count hits
-        ``target`` (replay path; reference setRecordCountTarget:111)."""
-        if target < self.record_count:
+        """Register an async determinant to fire when ``record_count`` hits
+        ``target`` within ``epoch`` (default: the current epoch) — replay
+        path, reference setRecordCountTarget:111. A target in a *future*
+        epoch may be pre-registered (e.g. record-count-0 events that fire
+        the moment the next epoch starts, reference startNewEpoch:94-103);
+        a target already passed within the current epoch is an error."""
+        e = self.epoch_id if epoch is None else epoch
+        if e < self.epoch_id or (e == self.epoch_id
+                                 and target < self.record_count):
             raise ValueError(
-                f"target {target} already passed (record_count={self.record_count})")
-        entry = (target, self._seq, det, callback)
+                f"target epoch={e} count={target} already passed "
+                f"(epoch={self.epoch_id}, record_count={self.record_count})")
+        entry = (e, target, self._seq, det, callback)
         self._seq += 1
         # seq is unique, so tuple comparison never reaches the determinant.
         bisect.insort(self._targets, entry)
@@ -110,8 +133,13 @@ class EpochTracker:
         self.fire_due_events()
 
     def fire_due_events(self) -> None:
-        while self._targets and self._targets[0][0] <= self.record_count:
-            _, _, det, callback = self._targets.pop(0)
+        while self._targets:
+            e, target, _, det, callback = self._targets[0]
+            due = e < self.epoch_id or (e == self.epoch_id
+                                        and target <= self.record_count)
+            if not due:
+                return
+            self._targets.pop(0)
             callback(det)
 
     @property

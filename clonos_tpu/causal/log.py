@@ -253,13 +253,16 @@ class ThreadCausalLog:
     control plane's host-side log manipulation.
     """
 
+    # Jitted wrappers are class-level so every instance shares one trace/
+    # compile cache (dozens of host-side wrappers exist per device).
+    _append1 = staticmethod(jax.jit(append_one))
+    _append = staticmethod(jax.jit(append))
+    _truncate = staticmethod(jax.jit(truncate))
+    _start_epoch = staticmethod(jax.jit(start_epoch))
+    _merge = staticmethod(jax.jit(merge_delta))
+
     def __init__(self, capacity: int = 1 << 12, max_epochs: int = 64):
         self.state = create(capacity, max_epochs)
-        self._append1 = jax.jit(append_one)
-        self._append = jax.jit(append, static_argnums=())
-        self._truncate = jax.jit(truncate)
-        self._start_epoch = jax.jit(start_epoch)
-        self._merge = jax.jit(merge_delta)
 
     def append_rows(self, rows: np.ndarray) -> None:
         if rows.ndim != 2 or rows.shape[1] != NUM_LANES:

@@ -1,0 +1,174 @@
+"""Causal services: intercepted nondeterminism for user/control code.
+
+Capability parity with the reference's services API
+(flink-core .../api/common/services/{TimeService,RandomService,
+SerializableService,SerializableServiceFactory}.java, implementations in
+flink-runtime .../causal/services/ — AbstractCausalService.java:40-73 with
+the append-even-during-replay invariant :61-64, CausalTimeService.java:48-67,
+PeriodicCausalTimeService, DeterministicCausalRandomService,
+CausalSerializableServiceFactory; README example README.md:46-77).
+
+TPU split of responsibilities:
+
+- The *per-superstep* time/RNG values are step inputs logged by the
+  executor itself (TIMESTAMP/RNG rows in the fixed per-step layout) — the
+  PeriodicCausalTimeService model: one amortized read per superstep powers
+  `ctx.time`/`ctx.rng_bits` inside compiled operators.
+- These services cover *host-side* user/control code (sources pulling
+  external data, sinks calling external systems, timers): each call logs an
+  async determinant row into the owning task's device log **between**
+  supersteps, via the executor's ``append_async_determinant`` hook. During
+  replay the service serves recorded values back (and re-appends them, the
+  reference's invariant, so the rebuilt log is bit-identical).
+"""
+
+from __future__ import annotations
+
+import time as _time
+from typing import Callable, List, Optional, Tuple
+
+import numpy as np
+
+from clonos_tpu.causal import determinant as det
+
+
+class ReplayFeed:
+    """Recorded async determinants for one task, served in order during
+    replay (the service-side face of the LogReplayer)."""
+
+    def __init__(self, dets: List[det.Determinant]):
+        self._dets = list(dets)
+        self._pos = 0
+
+    def next_of(self, cls) -> det.Determinant:
+        """Next recorded determinant, which must be of ``cls`` — recorded
+        and replayed nondeterminism must line up one-to-one (reference
+        LogReplayer.replayNext* contract)."""
+        if self._pos >= len(self._dets):
+            raise RuntimeError(
+                f"replay feed exhausted: expected a {cls.__name__}")
+        d = self._dets[self._pos]
+        if not isinstance(d, cls):
+            raise RuntimeError(
+                f"replay feed mismatch: expected {cls.__name__}, recorded "
+                f"{type(d).__name__} — nondeterministic call order diverged")
+        self._pos += 1
+        return d
+
+    def exhausted(self) -> bool:
+        return self._pos >= len(self._dets)
+
+    @property
+    def remaining(self) -> int:
+        return len(self._dets) - self._pos
+
+
+class AbstractCausalService:
+    """Shared record/replay plumbing. ``append`` is the host->device-log
+    hook (executor.append_async_determinant bound to one task); appends
+    happen on the live path AND during replay (reference invariant
+    AbstractCausalService.java:61-64) so the rebuilt log matches."""
+
+    def __init__(self, append: Callable[[det.Determinant], None],
+                 replay_feed: Optional[ReplayFeed] = None):
+        self._append = append
+        self._feed = replay_feed
+
+    @property
+    def recovering(self) -> bool:
+        return self._feed is not None and not self._feed.exhausted()
+
+    def _record_or_replay(self, cls, make: Callable[[], det.Determinant]
+                          ) -> det.Determinant:
+        if self.recovering:
+            d = self._feed.next_of(cls)
+        else:
+            d = make()
+        self._append(d)
+        return d
+
+
+class CausalTimeService(AbstractCausalService):
+    """currentTimeMillis with record/replay (CausalTimeService.java:48)."""
+
+    def __init__(self, append, replay_feed=None, clock=None):
+        super().__init__(append, replay_feed)
+        self._clock = clock or (lambda: int(_time.time() * 1000))
+
+    def current_time_millis(self) -> int:
+        d = self._record_or_replay(
+            det.TimestampDeterminant,
+            lambda: det.TimestampDeterminant(timestamp=self._clock()))
+        return d.timestamp
+
+
+class CausalRandomService(AbstractCausalService):
+    """Host random draws with record/replay
+    (DeterministicCausalRandomService equivalent)."""
+
+    def __init__(self, append, replay_feed=None, seed: int = 0):
+        super().__init__(append, replay_feed)
+        self._rng = np.random.RandomState(seed)
+
+    def next_int(self, bound: int = 1 << 31) -> int:
+        d = self._record_or_replay(
+            det.RNGDeterminant,
+            lambda: det.RNGDeterminant(
+                value=int(self._rng.randint(0, bound, dtype=np.int64))))
+        return d.value
+
+
+class CausalSerializableService(AbstractCausalService):
+    """Wraps an arbitrary external call so its results replay
+    (CausalSerializableServiceFactory; the README example's
+    getSerializableServiceFactory entry point).
+
+    ``fn`` maps request bytes -> response bytes. On the live path the
+    response is stored in the sidecar store and its (key, len, crc) row
+    logged; during replay the recorded response is fetched instead of
+    calling ``fn`` (external systems are NOT re-invoked — exactly-once)."""
+
+    def __init__(self, append, fn: Callable[[bytes], bytes],
+                 sidecar: det.SidecarStore, epoch_of: Callable[[], int],
+                 replay_feed: Optional[ReplayFeed] = None):
+        super().__init__(append, replay_feed)
+        self._fn = fn
+        self._sidecar = sidecar
+        self._epoch_of = epoch_of
+
+    def apply(self, request: bytes) -> bytes:
+        if self.recovering:
+            d = self._feed.next_of(det.SerializableDeterminant)
+            self._append(d)
+            return self._sidecar.get(d)
+        response = self._fn(request)
+        d = self._sidecar.put(response, self._epoch_of())
+        self._append(d)
+        return response
+
+
+class CausalServiceFactory:
+    """Per-task bundle (what the reference exposes through
+    StreamingRuntimeContext / ManagedInitializationContext)."""
+
+    def __init__(self, append, sidecar: det.SidecarStore,
+                 epoch_of: Callable[[], int],
+                 replay_feed: Optional[ReplayFeed] = None,
+                 seed: int = 0, clock=None):
+        self._append = append
+        self._sidecar = sidecar
+        self._epoch_of = epoch_of
+        self._feed = replay_feed
+        self._seed = seed
+        self._clock = clock
+
+    def time_service(self) -> CausalTimeService:
+        return CausalTimeService(self._append, self._feed, self._clock)
+
+    def random_service(self) -> CausalRandomService:
+        return CausalRandomService(self._append, self._feed, self._seed)
+
+    def serializable_service(self, fn: Callable[[bytes], bytes]
+                             ) -> CausalSerializableService:
+        return CausalSerializableService(self._append, fn, self._sidecar,
+                                         self._epoch_of, self._feed)

@@ -1,0 +1,298 @@
+"""In-flight log: retained output batches for replay after downstream failure.
+
+Capability parity with the reference's ``inflightlogging`` package
+(flink-runtime .../inflightlogging — InFlightLog.java API: log/getIterator/
+notifyCheckpointComplete; InMemorySubpartitionInFlightLogger.java;
+SpillableSubpartitionInFlightLogger.java:45 with per-epoch spill files so a
+completed checkpoint deletes its file; SpilledReplayIterator.java:61 with
+prefetch threads) — re-designed for TPU:
+
+- The hot path is a **device ring**: one edge's routed output batches are a
+  ``[S, P, cap]`` tensor ring over supersteps, appended in the jitted step
+  (same absolute-offset/epoch-index scheme as the causal log — see
+  causal/log.py). Replay of the last epochs is a device-side slice feed —
+  no host round trip for the common in-HBM case.
+- **Spill** runs at epoch boundaries on the host: the just-finished epoch's
+  step range is device_get as one contiguous block and written to one file
+  per epoch (truncation == file delete, exactly the reference's trick).
+  HBM->host DRAM->disk instead of JVM heap->disk.
+- **Replay** for spilled epochs is a producer/consumer iterator with a
+  prefetch thread (SpilledReplayIterator analog) that streams epoch files
+  back as device arrays in step order.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+from typing import Iterator, List, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from clonos_tpu.api.records import RecordBatch
+
+
+class EdgeLogState(NamedTuple):
+    """Device ring of one edge's routed batches, indexed by absolute
+    superstep count. Offsets follow causal/log.py discipline: absolute,
+    monotonic; ring position = offset & (S-1); truncation moves ``tail``."""
+
+    keys: jnp.ndarray         # int32[S, P, cap]
+    values: jnp.ndarray       # int32[S, P, cap]
+    timestamps: jnp.ndarray   # int32[S, P, cap]
+    valid: jnp.ndarray        # bool[S, P, cap]
+    head: jnp.ndarray         # int32 scalar: absolute steps appended
+    tail: jnp.ndarray         # int32 scalar: oldest retained step
+    epoch_starts: jnp.ndarray # int32[max_epochs]
+    epoch_base: jnp.ndarray   # int32 scalar
+    latest_epoch: jnp.ndarray # int32 scalar
+
+    @property
+    def ring_steps(self) -> int:
+        return self.keys.shape[0]
+
+    @property
+    def max_epochs(self) -> int:
+        return self.epoch_starts.shape[0]
+
+
+def create(ring_steps: int, parallelism: int, capacity: int,
+           max_epochs: int) -> EdgeLogState:
+    if ring_steps & (ring_steps - 1):
+        raise ValueError(f"ring_steps must be a power of two, got {ring_steps}")
+    z = jnp.asarray(0, jnp.int32)
+    shape = (ring_steps, parallelism, capacity)
+    return EdgeLogState(
+        keys=jnp.zeros(shape, jnp.int32), values=jnp.zeros(shape, jnp.int32),
+        timestamps=jnp.zeros(shape, jnp.int32),
+        valid=jnp.zeros(shape, jnp.bool_),
+        head=z, tail=z, epoch_starts=jnp.zeros((max_epochs,), jnp.int32),
+        epoch_base=z, latest_epoch=z)
+
+
+def size(state: EdgeLogState) -> jnp.ndarray:
+    return state.head - state.tail
+
+
+def overflowed(state: EdgeLogState) -> jnp.ndarray:
+    """True when un-truncated (and un-spilled) steps exceed the ring — the
+    control plane must spill or checkpoint before this bites (the JVM analog
+    is the buffer pool running dry, which *blocks* the producer; here the
+    executor's epoch loop checks and stalls)."""
+    return size(state) > state.ring_steps
+
+
+def append_step(state: EdgeLogState, batch: RecordBatch) -> EdgeLogState:
+    """Log one superstep's routed batch (reference InFlightLog.log)."""
+    pos = state.head & (state.ring_steps - 1)
+    return state._replace(
+        keys=state.keys.at[pos].set(batch.keys),
+        values=state.values.at[pos].set(batch.values),
+        timestamps=state.timestamps.at[pos].set(batch.timestamps),
+        valid=state.valid.at[pos].set(batch.valid),
+        head=state.head + 1)
+
+
+def start_epoch(state: EdgeLogState, epoch_id) -> EdgeLogState:
+    return start_epoch_at(state, epoch_id, state.head)
+
+
+def start_epoch_at(state: EdgeLogState, epoch_id, offset) -> EdgeLogState:
+    """Record epoch ``epoch_id``'s replay-start offset explicitly.
+
+    The executor records ``head - 1`` at the fence: the batch appended at
+    the fence's last step is still *in flight* (depth-1 pipeline — its
+    consumer reads it one step after the fence), so recovering a consumer
+    from this fence needs that one pre-fence batch. Truncation through this
+    marker keeps it alive (the aligned-barrier boundary condition the
+    reference gets from barriers flowing through the pipeline)."""
+    e = jnp.asarray(epoch_id, jnp.int32)
+    slot = e % state.max_epochs
+    return state._replace(
+        epoch_starts=state.epoch_starts.at[slot].set(
+            jnp.asarray(offset, jnp.int32)),
+        latest_epoch=jnp.maximum(state.latest_epoch, e))
+
+
+def epoch_start_step(state: EdgeLogState, epoch_id) -> jnp.ndarray:
+    e = jnp.asarray(epoch_id, jnp.int32)
+    return state.epoch_starts[e % state.max_epochs]
+
+
+def truncate(state: EdgeLogState, completed_epoch) -> EdgeLogState:
+    """Checkpoint complete: drop steps of epochs <= completed_epoch
+    (reference notifyCheckpointComplete -> per-epoch file delete)."""
+    e = jnp.asarray(completed_epoch, jnp.int32)
+    new_tail = jnp.maximum(epoch_start_step(state, e + 1), state.tail)
+    return state._replace(tail=new_tail,
+                          epoch_base=jnp.maximum(e + 1, state.epoch_base))
+
+
+def slice_steps(state: EdgeLogState, abs_step, max_out: int
+                ) -> Tuple[RecordBatch, jnp.ndarray, jnp.ndarray]:
+    """Gather up to ``max_out`` retained steps from ``abs_step``. Returns
+    (stacked RecordBatch [max_out, P, cap], count, start). The replay feed
+    (reference getInFlightIterator)."""
+    start = jnp.maximum(jnp.asarray(abs_step, jnp.int32), state.tail)
+    count = jnp.clip(state.head - start, 0, max_out)
+    idx = jnp.arange(max_out, dtype=jnp.int32)
+    pos = (start + idx) & (state.ring_steps - 1)
+    live = (idx < count)[:, None, None]
+    batch = RecordBatch(
+        keys=jnp.where(live, state.keys[pos], 0),
+        values=jnp.where(live, state.values[pos], 0),
+        timestamps=jnp.where(live, state.timestamps[pos], 0),
+        valid=jnp.where(live, state.valid[pos], False))
+    return batch, count, start
+
+
+# --- host spill path ---------------------------------------------------------
+
+
+class SpillPolicy:
+    """When to move completed-epoch step ranges out of the device ring
+    (reference InFlightLogConfig spill.policy eager|availability|epoch)."""
+
+    EAGER = "eager"            # spill every epoch as soon as it closes
+    AVAILABILITY = "availability"  # spill when ring occupancy crosses a ratio
+    DISABLED = "disabled"      # in-memory only (InMemory logger equivalent)
+
+
+class SpillingInFlightLog:
+    """Host-side owner of one edge's spilled epochs.
+
+    One file per epoch (``epoch_{id}.npz``) so truncation deletes files —
+    the reference's SpillableSubpartitionInFlightLogger file-per-epoch
+    design. Writes happen on a background thread; ``flush_failure`` keeps
+    the data host-resident (reference keeps the buffer in memory on flush
+    failure) so replay still works.
+    """
+
+    def __init__(self, spool_dir: Optional[str], edge_id: int,
+                 policy: str = SpillPolicy.EAGER,
+                 availability_trigger: float = 0.3):
+        self.edge_id = edge_id
+        self.policy = policy
+        self.availability_trigger = availability_trigger
+        self.spool_dir = spool_dir
+        if spool_dir:
+            os.makedirs(spool_dir, exist_ok=True)
+        # epoch -> (start_step, dict-of-arrays or filename)
+        self._epochs: dict = {}
+        self._lock = threading.Lock()
+        self._writer_queue: "queue.Queue" = queue.Queue()
+        self._writer = threading.Thread(target=self._writer_loop, daemon=True)
+        self._writer.start()
+
+    def _path(self, epoch: int) -> str:
+        return os.path.join(self.spool_dir,
+                            f"edge{self.edge_id}_epoch{epoch}.npz")
+
+    def _writer_loop(self):
+        while True:
+            item = self._writer_queue.get()
+            if item is None:
+                return
+            epoch, start, arrays = item
+            try:
+                np.savez(self._path(epoch), start=start, **arrays)
+                with self._lock:
+                    # Only demote to file if the epoch wasn't truncated
+                    # while the write raced it.
+                    if epoch in self._epochs:
+                        self._epochs[epoch] = (start, self._path(epoch))
+            except OSError:
+                # Flush failure: keep host-memory copy (reference
+                # FlushCompletedCallback failure path).
+                pass
+            finally:
+                self._writer_queue.task_done()
+
+    def spill_epoch(self, epoch: int, start_step: int,
+                    batches: RecordBatch) -> None:
+        """Accept one closed epoch's stacked steps ([n, P, cap] per field)."""
+        arrays = {
+            "keys": np.asarray(batches.keys),
+            "values": np.asarray(batches.values),
+            "timestamps": np.asarray(batches.timestamps),
+            "valid": np.asarray(batches.valid),
+        }
+        with self._lock:
+            self._epochs[epoch] = (start_step, arrays)
+        if self.spool_dir and self.policy != SpillPolicy.DISABLED:
+            self._writer_queue.put((epoch, start_step, arrays))
+
+    def truncate(self, completed_epoch: int) -> None:
+        with self._lock:
+            dead = [e for e in self._epochs if e <= completed_epoch]
+            for e in dead:
+                _, payload = self._epochs.pop(e)
+                if isinstance(payload, str):
+                    try:
+                        os.remove(payload)
+                    except OSError:
+                        pass
+
+    def retained_epochs(self) -> List[int]:
+        with self._lock:
+            return sorted(self._epochs)
+
+    def load_epoch(self, epoch: int) -> Tuple[int, RecordBatch]:
+        """Synchronous read of one epoch (start_step, steps[n, P, cap])."""
+        with self._lock:
+            start, payload = self._epochs[epoch]
+        if isinstance(payload, str):
+            with np.load(payload) as z:
+                payload = {k: z[k] for k in
+                           ("keys", "values", "timestamps", "valid")}
+        return start, RecordBatch(
+            jnp.asarray(payload["keys"]), jnp.asarray(payload["values"]),
+            jnp.asarray(payload["timestamps"]), jnp.asarray(payload["valid"]))
+
+    def drain(self) -> None:
+        """Block until pending spill writes are durable (tests/shutdown)."""
+        self._writer_queue.join()
+
+    def close(self) -> None:
+        self._writer_queue.put(None)
+
+
+class ReplayIterator:
+    """Prefetching replay of epochs [from_epoch, to_epoch], step-ordered
+    (reference SpilledReplayIterator.java:61: producer thread fills
+    per-epoch deques; consumer blocks on the deque head).
+
+    ``skip_steps`` skips already-delivered steps of the first epoch
+    (reference InFlightLogRequestEvent.numBuffersToSkip dedup)."""
+
+    def __init__(self, log: SpillingInFlightLog, from_epoch: int,
+                 to_epoch: int, skip_steps: int = 0, prefetch: int = 2):
+        self._q: "queue.Queue" = queue.Queue(maxsize=max(1, prefetch))
+        self._epochs = [e for e in log.retained_epochs()
+                        if from_epoch <= e <= to_epoch]
+        self._log = log
+        self._skip = skip_steps
+        self._t = threading.Thread(target=self._produce, daemon=True)
+        self._t.start()
+
+    def _produce(self):
+        first = True
+        for e in self._epochs:
+            start, batch = self._log.load_epoch(e)
+            n = batch.keys.shape[0]
+            lo = self._skip if first else 0
+            first = False
+            for i in range(lo, n):
+                self._q.put((start + i, jax.tree_util.tree_map(
+                    lambda x: x[i], batch)))
+        self._q.put(None)
+
+    def __iter__(self) -> Iterator[Tuple[int, RecordBatch]]:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            yield item

@@ -1,0 +1,140 @@
+"""Batched record routing: the TPU form of the network exchange.
+
+The reference partitions record-at-a-time through channel selectors
+(flink-streaming-java .../runtime/partitioner/{KeyGroupStreamPartitioner,
+RebalancePartitioner,BroadcastPartitioner}.java) and moves bytes over netty
+(io/network/partition/ResultPartition.java:86 ->
+consumer/SingleInputGate.java:107). Here an exchange is one dense op on the
+whole batch: compute a target subtask per record, stable-sort by target, and
+scatter into a fixed-capacity per-subtask buffer. Under ``jit`` over a mesh
+the scatter lowers to an all-to-all on ICI — XLA inserts the collective;
+there is no hand-written transport.
+
+Determinism note: routing is a pure function of the input batch (stable sort
+keeps arrival order within a target), so exchanges need **no** determinants —
+only the *selection* of which queued batch a multi-input vertex consumes is
+nondeterministic (logged as ORDER, see runtime/executor.py).
+
+Key-group discipline matches the reference: state is sharded by
+``key_group = hash(key) % num_key_groups`` and key groups map to subtasks as
+``kg * parallelism // num_key_groups``
+(flink-runtime .../state/KeyGroupRangeAssignment.java).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+
+from clonos_tpu.api.records import RecordBatch, zero_invalid
+
+
+def hash32(x: jnp.ndarray) -> jnp.ndarray:
+    """splitmix32-style avalanche hash on int32 (uint32 arithmetic)."""
+    u = x.astype(jnp.uint32)
+    u = (u ^ (u >> 16)) * jnp.uint32(0x7FEB352D)
+    u = (u ^ (u >> 15)) * jnp.uint32(0x846CA68B)
+    u = u ^ (u >> 16)
+    return u
+
+
+def key_group(keys: jnp.ndarray, num_key_groups: int) -> jnp.ndarray:
+    return (hash32(keys) % jnp.uint32(num_key_groups)).astype(jnp.int32)
+
+
+def subtask_for_key_group(kg: jnp.ndarray, parallelism: int,
+                          num_key_groups: int) -> jnp.ndarray:
+    # Matches KeyGroupRangeAssignment.computeOperatorIndexForKeyGroup.
+    return (kg * parallelism) // num_key_groups
+
+
+def key_group_range(subtask: int, parallelism: int,
+                    num_key_groups: int) -> Tuple[int, int]:
+    """[start, end) of key groups owned by ``subtask``."""
+    start = -(-subtask * num_key_groups // parallelism)  # ceil div
+    end = -(-(subtask + 1) * num_key_groups // parallelism)
+    return start, end
+
+
+def _scatter_to_targets(
+    batch: RecordBatch, target: jnp.ndarray, num_targets: int, out_capacity: int
+) -> Tuple[RecordBatch, jnp.ndarray]:
+    """Core exchange: flatten, stable-sort by target, scatter to
+    ``[num_targets, out_capacity]``. Returns (routed, dropped_per_target)."""
+    flat = jnp.reshape
+    n = batch.keys.size
+    keys, vals, ts, valid = (flat(batch.keys, (n,)), flat(batch.values, (n,)),
+                             flat(batch.timestamps, (n,)), flat(batch.valid, (n,)))
+    target = jnp.where(valid, flat(target, (n,)), num_targets)  # invalid last
+    order = jnp.argsort(target, stable=True)
+    st, sk, sv, sts = target[order], keys[order], vals[order], ts[order]
+    # Position of each sorted record within its target's run.
+    idx = jnp.arange(n, dtype=jnp.int32)
+    run_start = jnp.searchsorted(st, jnp.arange(num_targets + 1, dtype=st.dtype),
+                                 side="left").astype(jnp.int32)
+    pos = idx - run_start[jnp.clip(st, 0, num_targets)]
+    live = st < num_targets
+    keep = live & (pos < out_capacity)
+    dropped = jnp.zeros((num_targets,), jnp.int32).at[st].add(
+        (live & ~keep).astype(jnp.int32), mode="drop")
+    # Scatter; out-of-range rows (dropped/invalid) routed to a drop slot.
+    row = jnp.where(keep, st, num_targets)
+    col = jnp.where(keep, pos, 0)
+    shape = (num_targets + 1, out_capacity)
+    out = RecordBatch(
+        keys=jnp.zeros(shape, jnp.int32).at[row, col].set(sk, mode="drop"),
+        values=jnp.zeros(shape, jnp.int32).at[row, col].set(sv, mode="drop"),
+        timestamps=jnp.zeros(shape, jnp.int32).at[row, col].set(sts, mode="drop"),
+        valid=jnp.zeros(shape, jnp.bool_).at[row, col].set(keep, mode="drop"),
+    )
+    out = RecordBatch(out.keys[:num_targets], out.values[:num_targets],
+                      out.timestamps[:num_targets], out.valid[:num_targets])
+    return zero_invalid(out), dropped
+
+
+def route_hash(batch: RecordBatch, parallelism: int, num_key_groups: int,
+               out_capacity: int) -> Tuple[RecordBatch, jnp.ndarray]:
+    """keyBy exchange (KeyGroupStreamPartitioner equivalent)."""
+    kg = key_group(batch.keys, num_key_groups)
+    return _scatter_to_targets(
+        batch, subtask_for_key_group(kg, parallelism, num_key_groups),
+        parallelism, out_capacity)
+
+
+def route_rebalance(batch: RecordBatch, parallelism: int, out_capacity: int,
+                    offset=0) -> Tuple[RecordBatch, jnp.ndarray]:
+    """Deterministic round-robin by global record index (the reference's
+    RebalancePartitioner starts at a *random* channel — randomness it must
+    log via RandomService, RecordWriter.java:131-137; a deterministic cycle
+    with a carried ``offset`` needs no determinant)."""
+    n = batch.keys.size
+    idx = jnp.arange(n, dtype=jnp.int32) + jnp.asarray(offset, jnp.int32)
+    return _scatter_to_targets(batch, (idx % parallelism).reshape(batch.keys.shape),
+                               parallelism, out_capacity)
+
+
+def route_forward(batch: RecordBatch, out_capacity: int
+                  ) -> Tuple[RecordBatch, jnp.ndarray]:
+    """1:1 edge: same subtask index downstream, re-capacitied."""
+    p, b = batch.keys.shape
+    if out_capacity == b:
+        return zero_invalid(batch), jnp.zeros((p,), jnp.int32)
+    if out_capacity > b:
+        pad = ((0, 0), (0, out_capacity - b))
+        return RecordBatch(*(jnp.pad(x, pad) for x in batch)), jnp.zeros((p,), jnp.int32)
+    keep = batch.valid[:, :out_capacity]
+    dropped = batch.count() - keep.sum(-1).astype(jnp.int32)
+    return zero_invalid(RecordBatch(
+        batch.keys[:, :out_capacity], batch.values[:, :out_capacity],
+        batch.timestamps[:, :out_capacity], keep)), dropped
+
+
+def route_broadcast(batch: RecordBatch, parallelism: int, out_capacity: int
+                    ) -> Tuple[RecordBatch, jnp.ndarray]:
+    """Every downstream subtask receives every record (compacted)."""
+    target = jnp.zeros(batch.keys.shape, jnp.int32)
+    one, dropped = _scatter_to_targets(batch, target, 1, out_capacity)
+    rep = RecordBatch(*(jnp.broadcast_to(x[0], (parallelism,) + x.shape[1:])
+                        for x in one))
+    return rep, jnp.broadcast_to(dropped[0], (parallelism,)).astype(jnp.int32)

@@ -1,0 +1,461 @@
+"""Superstep executor: the whole job as one jitted program.
+
+This replaces the reference's task plane + stream runtime
+(taskexecutor/TaskExecutor.java:422, taskmanager/Task.java:124,
+runtime/tasks/StreamTask.java and the OneInputStreamTask.run hot loop,
+OneInputStreamTask.java:106) with the TPU-native execution model:
+
+- Every vertex's subtasks are a leading ``[P]`` dim of its state/batches,
+  shardable over a ``jax.sharding.Mesh`` axis — the analog of deploying
+  subtasks to TaskManagers.
+- One **superstep** advances every vertex by one batch concurrently:
+  vertex v consumes the batch its upstream routed in the *previous*
+  superstep (depth-1 edge buffers). That is pipeline parallelism — all
+  stages busy every step — without any queues/threads/backpressure
+  machinery; the exchange scatter lowers to ICI all-to-alls under jit.
+- The per-superstep causal determinants (TIMESTAMP of the causal time
+  input, ORDER of the consumed channel, BUFFER_BUILT with the emitted
+  record count — reference CausalBufferOrderService.java:112,
+  PipelinedSubpartition buffer cuts) are appended to a **stacked device
+  log** ``int32[L, capacity, 8]`` (L = all subtasks) in one fused
+  ``vmap(append)`` — the per-record JVM hot path becomes one op.
+- Epoch bookkeeping (record counts) is carried as ``int32[L]`` scalars
+  (EpochState vectorized over subtasks).
+
+Host Python never touches records: it feeds causal time/RNG scalars in and
+reads sink batches out; epochs run as ``lax.scan`` over supersteps.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time as _time
+from functools import partial
+from typing import Any, Dict, List, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from clonos_tpu.api.operators import OpContext
+from clonos_tpu.api.records import RecordBatch, empty, zero_invalid
+from clonos_tpu.causal import log as clog
+from clonos_tpu.causal import determinant as det
+from clonos_tpu.causal import replication as rep
+from clonos_tpu.graph.job_graph import JobGraph, PartitionType
+from clonos_tpu.inflight import log as ifl
+from clonos_tpu.parallel import routing
+
+# Determinants appended per subtask per superstep on the sync path, in this
+# fixed order: TIMESTAMP (causal time read), RNG (causal host-RNG draw),
+# ORDER (consumed channel), BUFFER_BUILT (emitted batch cut). The fixed
+# layout is what lets the replayer parse the log as a [steps, 4, lanes]
+# tensor on device.
+DETS_PER_STEP = 4
+
+
+class StepInputs(NamedTuple):
+    """Host-fed nondeterminism for one superstep (all int32 scalars). On the
+    live path these come from the causal services; during replay, from the
+    determinant log."""
+
+    time: jnp.ndarray
+    rng_bits: jnp.ndarray
+
+
+class JobCarry(NamedTuple):
+    """The complete device-resident job state (the jitted step's carry)."""
+
+    op_states: Tuple[Any, ...]          # per-vertex operator state pytrees
+    edge_bufs: Tuple[RecordBatch, ...]  # per-edge routed batch [P_dst, cap]
+    rr_offsets: Tuple[jnp.ndarray, ...] # per-edge [1] round-robin cursors
+    record_counts: jnp.ndarray          # int32[L] records consumed per subtask
+    logs: clog.ThreadLogState           # stacked [L, cap, lanes]
+    edge_logs: Tuple[ifl.EdgeLogState, ...]  # per-edge in-flight rings
+    replicas: clog.ThreadLogState       # stacked [R, cap, lanes] piggyback
+                                        # replicas (see causal/replication.py)
+
+
+class StepOutputs(NamedTuple):
+    sinks: Dict[int, RecordBatch]       # vertex_id -> emitted batch
+    dropped: Dict[int, jnp.ndarray]     # edge index -> [P_dst] drops
+    consumed: jnp.ndarray               # int32[L] records consumed this step
+
+
+def _det_row(tag: int, rc, payload: List) -> jnp.ndarray:
+    """Build one packed determinant row from traced scalars."""
+    row = jnp.zeros((det.NUM_LANES,), jnp.int32)
+    row = row.at[det.LANE_TAG].set(tag)
+    row = row.at[det.LANE_RC].set(jnp.asarray(rc, jnp.int32))
+    for i, p in enumerate(payload):
+        row = row.at[det.LANE_P + i].set(jnp.asarray(p, jnp.int32))
+    return row
+
+
+@dataclasses.dataclass
+class CompiledJob:
+    """A job graph lowered to (init_carry, superstep) pure functions."""
+
+    job: JobGraph
+    log_capacity: int = 1 << 14
+    max_epochs: int = 64
+    inflight_ring_steps: int = 64
+    mesh: Optional[jax.sharding.Mesh] = None
+    task_axis: str = "tasks"
+
+    def __post_init__(self):
+        self.job.validate()
+        self.topo = self.job.topo_order()
+        self.L = self.job.total_subtasks()
+        self.plan = rep.ReplicationPlan.from_job(self.job,
+                                                 self.job.sharing_depth)
+        self._owner_idx = self.plan.owner_index()
+        # Per-round delta budget: worst-case per-step log growth with slack
+        # to re-converge after epoch-fence bursts.
+        self.max_delta = 4 * DETS_PER_STEP
+
+    # --- sharding -----------------------------------------------------------
+
+    def _shard_leading(self, x: jnp.ndarray) -> jnp.ndarray:
+        """Constrain a [P, ...] or [L, ...] array to be sharded over the task
+        mesh axis when divisible (the subtask->device deployment)."""
+        if self.mesh is None:
+            return x
+        n = self.mesh.shape[self.task_axis]
+        if x.ndim == 0 or x.shape[0] % n != 0:
+            return x
+        spec = jax.sharding.PartitionSpec(self.task_axis,
+                                          *(None,) * (x.ndim - 1))
+        return jax.lax.with_sharding_constraint(
+            x, jax.sharding.NamedSharding(self.mesh, spec))
+
+    def _shard_tree(self, tree):
+        return jax.tree_util.tree_map(self._shard_leading, tree)
+
+    # --- initialization -----------------------------------------------------
+
+    def init_carry(self) -> JobCarry:
+        op_states = tuple(
+            v.operator.init_state(v.parallelism) for v in self.job.vertices)
+        edge_bufs = tuple(
+            empty((self.job.vertices[e.dst].parallelism, e.capacity))
+            for e in self.job.edges)
+        rr = tuple(jnp.zeros((1,), jnp.int32) for _ in self.job.edges)
+        logs = jax.vmap(lambda _: clog.create(self.log_capacity, self.max_epochs)
+                        )(jnp.arange(self.L))
+        edge_logs = tuple(
+            ifl.create(self.inflight_ring_steps,
+                       self.job.vertices[e.dst].parallelism, e.capacity,
+                       self.max_epochs)
+            for e in self.job.edges)
+        replicas = rep.create_replicas(self.plan, self.log_capacity,
+                                       self.max_epochs)
+        carry = JobCarry(op_states, edge_bufs, rr,
+                         jnp.zeros((self.L,), jnp.int32), logs, edge_logs,
+                         replicas)
+        return self._shard_tree(carry)
+
+    # --- the superstep ------------------------------------------------------
+
+    def superstep(self, carry: JobCarry, inputs: StepInputs
+                  ) -> Tuple[JobCarry, StepOutputs]:
+        job = self.job
+        op_states = list(carry.op_states)
+        edge_bufs = list(carry.edge_bufs)
+        rr_offsets = list(carry.rr_offsets)
+        edge_logs = list(carry.edge_logs)
+        sinks: Dict[int, RecordBatch] = {}
+        dropped: Dict[int, jnp.ndarray] = {}
+        consumed_parts: Dict[int, jnp.ndarray] = {}
+        det_rows_parts: Dict[int, jnp.ndarray] = {}
+        det_counts_parts: Dict[int, jnp.ndarray] = {}
+
+        for vid in self.topo:
+            v = job.vertices[vid]
+            p = v.parallelism
+            in_edges = job.in_edges(vid)
+            if in_edges:
+                # Single-input vertices for now (validate() enforces); the
+                # consumed-channel choice is still logged as ORDER so the
+                # piggyback/replay machinery carries realistic load.
+                eidx = in_edges[0]
+                # Read the *previous* superstep's routed batch (depth-1
+                # pipeline): every vertex computes concurrently within a
+                # superstep, with no intra-step data dependency chain.
+                batch = carry.edge_bufs[eidx]
+                channel = jnp.zeros((), jnp.int32)
+            else:
+                cap = v.operator.out_capacity or 1
+                batch = empty((p, cap))
+                channel = jnp.zeros((), jnp.int32)
+
+            ctx = OpContext(
+                time=inputs.time, epoch=jnp.zeros((), jnp.int32),
+                step=jnp.zeros((), jnp.int32), rng_bits=inputs.rng_bits,
+                subtask=jnp.arange(p, dtype=jnp.int32),
+            )
+            consumed = batch.count() if in_edges else jnp.zeros((p,), jnp.int32)
+            state, out = v.operator.process(op_states[vid], batch, ctx)
+            op_states[vid] = self._shard_tree(state)
+            out = self._shard_tree(out)
+            if in_edges and not job.out_edges(vid):
+                sinks[vid] = out
+            # Sources "consume" what they emit (their record count advances
+            # with generated records, like the reference's source loop).
+            if not in_edges:
+                consumed = out.count()
+            consumed_parts[vid] = consumed
+
+            # Determinants for this vertex's subtasks: one [P, 3, lanes]
+            # block. TIMESTAMP covers the causal-time read; ORDER the channel
+            # selection; BUFFER_BUILT the emitted batch cut.
+            t_hi = jnp.where(inputs.time < 0, -1, 0)
+            ts_row = _det_row(det.TIMESTAMP, 0, [t_hi, inputs.time])
+            rng_row = _det_row(det.RNG, 0, [inputs.rng_bits])
+            ord_row = _det_row(det.ORDER, 0, [channel])
+            emit_counts = out.count()                      # [P]
+            bb_rows = jax.vmap(
+                lambda n: _det_row(det.BUFFER_BUILT, 0, [n]))(emit_counts)
+            block = jnp.stack([
+                jnp.broadcast_to(ts_row, (p, det.NUM_LANES)),
+                jnp.broadcast_to(rng_row, (p, det.NUM_LANES)),
+                jnp.broadcast_to(ord_row, (p, det.NUM_LANES)),
+                bb_rows,
+            ], axis=1)                                     # [P, 4, lanes]
+            det_rows_parts[vid] = block
+            det_counts_parts[vid] = jnp.full((p,), DETS_PER_STEP, jnp.int32)
+
+            # Route to downstream edges.
+            for eidx in job.out_edges(vid):
+                e = job.edges[eidx]
+                dst_p = job.vertices[e.dst].parallelism
+                if e.partition == PartitionType.HASH:
+                    routed, drop = routing.route_hash(
+                        out, dst_p, job.num_key_groups, e.capacity)
+                elif e.partition == PartitionType.FORWARD:
+                    routed, drop = routing.route_forward(out, e.capacity)
+                elif e.partition == PartitionType.REBALANCE:
+                    routed, drop = routing.route_rebalance(
+                        out, dst_p, e.capacity, rr_offsets[eidx][0])
+                    rr_offsets[eidx] = (rr_offsets[eidx] + out.count().sum()
+                                        ) % jnp.asarray(dst_p, jnp.int32)
+                else:
+                    routed, drop = routing.route_broadcast(out, dst_p, e.capacity)
+                edge_bufs[eidx] = self._shard_tree(routed)
+                dropped[eidx] = drop
+                # In-flight logging: retain the routed batch for replay
+                # (reference PipelinedSubpartition.add -> InFlightLog.log).
+                edge_logs[eidx] = ifl.append_step(edge_logs[eidx], routed)
+
+        # Stack per-vertex determinant blocks in vertex-id order -> [L, 3, lanes]
+        all_rows = jnp.concatenate(
+            [det_rows_parts[v.vertex_id] for v in job.vertices], axis=0)
+        all_counts = jnp.concatenate(
+            [det_counts_parts[v.vertex_id] for v in job.vertices], axis=0)
+        consumed_all = jnp.concatenate(
+            [consumed_parts[v.vertex_id] for v in job.vertices], axis=0)
+        logs = clog.v_append(carry.logs, all_rows, all_counts)
+        logs = self._shard_tree(logs)
+
+        # Piggyback replication round: pull every owner's fresh determinant
+        # suffix into the downstream replicas (the per-message netty delta
+        # becomes one fused step-boundary collective).
+        if self.plan.num_replicas > 0:
+            replicas, _lag = rep.replicate_step(
+                carry.replicas, logs, self._owner_idx, self.max_delta)
+            replicas = self._shard_tree(replicas)
+        else:
+            replicas = carry.replicas
+
+        new_carry = JobCarry(
+            tuple(op_states), tuple(edge_bufs), tuple(rr_offsets),
+            carry.record_counts + consumed_all, logs, tuple(edge_logs),
+            replicas)
+        return new_carry, StepOutputs(sinks, dropped, consumed_all)
+
+    def run_steps(self, carry: JobCarry, inputs: StepInputs
+                  ) -> Tuple[JobCarry, StepOutputs]:
+        """Scan ``superstep`` over stacked inputs (leading dim = steps).
+        Outputs are stacked per step — the unit the epoch loop executes."""
+        return jax.lax.scan(self.superstep, carry, inputs)
+
+
+class CausalTimeSource:
+    """Host clock for the live path (reference CausalTimeService /
+    PeriodicCausalTimeService.java — one amortized read per superstep).
+    Produces int32 millis since executor start; values are recorded in every
+    task's log as TIMESTAMP determinants by the superstep itself."""
+
+    def __init__(self):
+        self._t0 = _time.monotonic()
+
+    def now(self) -> int:
+        return int((_time.monotonic() - self._t0) * 1000) & 0x7FFFFFFF
+
+
+class LocalExecutor:
+    """Single-process job driver (MiniCluster analog): owns the compiled
+    job, the carry, the causal time/RNG sources, and the epoch loop."""
+
+    def __init__(self, job: JobGraph, steps_per_epoch: int = 16,
+                 log_capacity: int = 1 << 14, max_epochs: int = 64,
+                 inflight_ring_steps: int = 64,
+                 mesh: Optional[jax.sharding.Mesh] = None,
+                 spool_dir: Optional[str] = None,
+                 seed: int = 0):
+        self.compiled = CompiledJob(job, log_capacity=log_capacity,
+                                    max_epochs=max_epochs,
+                                    inflight_ring_steps=inflight_ring_steps,
+                                    mesh=mesh)
+        self.job = job
+        self.steps_per_epoch = steps_per_epoch
+        self.carry = self.compiled.init_carry()
+        self.time_source = CausalTimeSource()
+        self._rng = np.random.RandomState(seed)
+        self.epoch_id = 0
+        self.step_in_epoch = 0
+        self._jit_step = jax.jit(self.compiled.superstep)
+        self._jit_scan = jax.jit(self.compiled.run_steps)
+
+        plan = self.compiled.plan
+
+        def _roll(carry: JobCarry, e) -> JobCarry:
+            # Epoch fence: catch-up replication so replica heads equal owner
+            # heads, then record the new epoch's start offset on every log,
+            # replica, and in-flight ring coherently.
+            replicas = carry.replicas
+            if plan.num_replicas > 0:
+                replicas, _ = rep.replicate_step(
+                    replicas, carry.logs, self.compiled._owner_idx,
+                    self.compiled.max_delta)
+                replicas = rep.sync_replica_epochs(replicas, e)
+            return carry._replace(
+                logs=clog.v_start_epoch(carry.logs, e),
+                # Ring markers sit one step before the fence: the last
+                # appended batch is still in flight (see start_epoch_at).
+                edge_logs=tuple(
+                    ifl.start_epoch_at(el, e, jnp.maximum(el.head - 1, 0))
+                    for el in carry.edge_logs),
+                replicas=replicas)
+
+        def _trunc(carry: JobCarry, e) -> JobCarry:
+            replicas = carry.replicas
+            if plan.num_replicas > 0:
+                replicas = clog.v_truncate(replicas, e)
+            return carry._replace(
+                logs=clog.v_truncate(carry.logs, e),
+                edge_logs=tuple(ifl.truncate(el, e)
+                                for el in carry.edge_logs),
+                replicas=replicas)
+
+        self._jit_roll = jax.jit(_roll)
+        self._jit_trunc = jax.jit(_trunc)
+        # Host-side spill owners, one per edge (None = spill disabled).
+        self.spill_logs: Optional[List[ifl.SpillingInFlightLog]] = None
+        if spool_dir is not None:
+            self.spill_logs = [
+                ifl.SpillingInFlightLog(spool_dir, edge_id=i)
+                for i in range(len(job.edges))]
+        # Epoch 0 starts at log offset 0 for every log.
+        self.carry = self._jit_roll(self.carry, 0)
+        self.step_input_history: List[Tuple[int, int]] = []
+
+    def _next_inputs(self) -> StepInputs:
+        t = self.time_source.now()
+        r = int(self._rng.randint(0, 2 ** 31, dtype=np.int64))
+        self.step_input_history.append((t, r))
+        return StepInputs(jnp.asarray(t, jnp.int32), jnp.asarray(r, jnp.int32))
+
+    def step(self) -> StepOutputs:
+        """Run one superstep on the live path."""
+        self.carry, out = self._jit_step(self.carry, self._next_inputs())
+        self.step_in_epoch += 1
+        return out
+
+    def run_epoch(self) -> StepOutputs:
+        """Run the remainder of the current epoch as one scanned device
+        program, then roll the epoch (the checkpoint fence lands here)."""
+        n = self.steps_per_epoch - self.step_in_epoch
+        if n > 0:
+            ins = [self._next_inputs() for _ in range(n)]
+            stacked = StepInputs(
+                jnp.stack([i.time for i in ins]),
+                jnp.stack([i.rng_bits for i in ins]))
+            self.carry, outs = self._jit_scan(self.carry, stacked)
+        else:
+            outs = None
+        closed = self.epoch_id
+        self.epoch_id += 1
+        self.step_in_epoch = 0
+        if self.spill_logs is not None:
+            self._spill_epoch(closed)
+        self.carry = self._jit_roll(self.carry, self.epoch_id)
+        return outs
+
+    def _spill_epoch(self, epoch: int) -> None:
+        """Move the just-closed epoch's in-flight batches to the host spill
+        owner (policy EAGER; reference SpillableSubpartitionInFlightLogger
+        writes one file per epoch as it closes)."""
+        for i, el in enumerate(self.carry.edge_logs):
+            start = int(ifl.epoch_start_step(el, epoch))
+            n = int(el.head) - start
+            if n <= 0:
+                continue
+            batch, count, s0 = ifl.slice_steps(el, start, n)
+            self.spill_logs[i].spill_epoch(epoch, int(s0), jax.device_get(batch))
+
+    def notify_checkpoint_complete(self, epoch: int) -> None:
+        """Truncate determinant + in-flight logs for epochs <= ``epoch``."""
+        self.carry = self._jit_trunc(self.carry, epoch)
+        if self.spill_logs is not None:
+            for sl in self.spill_logs:
+                sl.truncate(epoch)
+
+    def append_async_determinant(self, flat_subtask: int,
+                                 d: "det.Determinant") -> None:
+        """Host path for causal services: append one determinant row to a
+        task's device log between supersteps. TIMESTAMP/RNG rows get a
+        nonzero record-count stamp so the replayer can tell them apart from
+        the per-step sync anchors (see recovery.LogReplayer._parse)."""
+        row = d.pack().copy()
+        if row[det.LANE_RC] == 0 and row[det.LANE_TAG] in (det.TIMESTAMP,
+                                                           det.RNG):
+            row[det.LANE_RC] = self.global_record_stamp()
+        one = jax.tree_util.tree_map(lambda x: x[flat_subtask],
+                                     self.carry.logs)
+        one = clog.append_one(one, jnp.asarray(row, jnp.int32))
+        self.carry = self.carry._replace(logs=jax.tree_util.tree_map(
+            lambda s, r: s.at[flat_subtask].set(r), self.carry.logs, one))
+
+    def global_record_stamp(self) -> int:
+        """Monotone nonzero stamp for async rows (1 + supersteps run)."""
+        return len(self.step_input_history) + 1
+
+    def service_factory(self, flat_subtask: int,
+                        sidecar: "det.SidecarStore",
+                        replay_feed=None, seed: int = 0, clock=None):
+        """Per-task causal-service bundle (StreamingRuntimeContext analog:
+        user host code gets time/random/external-call wrappers whose values
+        record into this task's log and replay after failure)."""
+        from clonos_tpu.causal.services import CausalServiceFactory
+        return CausalServiceFactory(
+            append=lambda d: self.append_async_determinant(flat_subtask, d),
+            sidecar=sidecar, epoch_of=lambda: self.epoch_id,
+            replay_feed=replay_feed, seed=seed, clock=clock)
+
+    def restore(self, carry_host, epoch_id: int) -> None:
+        """Adopt a checkpointed carry (standby restore path; reference
+        Task.dispatchStateToStandbyTask -> initializeState). The carry must
+        be an epoch-``epoch_id``-boundary snapshot; the next step continues
+        epoch ``epoch_id``."""
+        self.carry = jax.tree_util.tree_map(jnp.asarray, carry_host)
+        self.epoch_id = epoch_id
+        self.step_in_epoch = 0
+
+    # --- introspection -------------------------------------------------------
+
+    def log_sizes(self) -> np.ndarray:
+        return np.asarray(clog.size(self.carry.logs))
+
+    def vertex_state(self, vertex_id: int):
+        return jax.device_get(self.carry.op_states[vertex_id])

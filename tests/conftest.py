@@ -4,12 +4,19 @@ The reference tests multi-node behavior without a cluster via the in-JVM
 MiniCluster (flink-runtime .../minicluster/MiniCluster.java:108). The JAX
 analog is forcing the host platform to expose 8 virtual devices, so every
 sharding/collective path is exercised single-process.
+
+Note: the JAX_PLATFORMS *environment variable* is overridden by the axon
+TPU PJRT plugin in this image; ``jax.config.update`` is authoritative, so
+the platform is forced through the config API after import.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402  (import after XLA_FLAGS is set)
+
+jax.config.update("jax_platforms", "cpu")

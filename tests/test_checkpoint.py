@@ -1,0 +1,118 @@
+"""Checkpoint coordination: trigger/ack/complete ledger, truncation hooks,
+standby dispatch, ignore-unacked, backoff, storage, and restore-equivalence
+(reference CheckpointCoordinator behaviors, §3.3 of SURVEY.md)."""
+
+import numpy as np
+import jax
+import pytest
+
+from clonos_tpu.api.environment import StreamEnvironment
+from clonos_tpu.runtime import checkpoint as cp
+from clonos_tpu.runtime.executor import LocalExecutor
+
+
+def _job(parallelism=2):
+    env = StreamEnvironment(num_key_groups=8)
+    (env.synthetic_source(vocab=7, batch_size=4, parallelism=parallelism)
+        .key_by().window_count(num_keys=7, window_size=10 ** 9).sink())
+    return env.build()
+
+
+def _coord(n=4, **kw):
+    return cp.CheckpointCoordinator(cp.InMemoryCheckpointStorage(),
+                                    num_subtasks=n, **kw)
+
+
+def test_complete_requires_all_acks_and_write():
+    c = _coord(n=2)
+    done, dispatched = [], []
+    c.subscribe_completion(done.append)
+    c.subscribe_completed_state(lambda ck: dispatched.append(ck.checkpoint_id))
+    c.trigger(0, {"x": np.arange(3)}, async_write=False)
+    assert done == []
+    c.ack(0, 0)
+    assert done == []
+    c.ack(0, 1)
+    assert done == [0] and dispatched == [0]
+    assert c.latest_completed_id == 0
+
+
+def test_retention_deletes_old_checkpoints():
+    c = _coord(n=1, max_retained=2)
+    for cid in range(4):
+        c.trigger(cid, {"v": np.asarray(cid)}, async_write=False)
+        c.ack(cid, 0)
+    assert c.storage.list_ids() == [2, 3]
+    assert c.latest_completed().carry["v"] == 3
+
+
+def test_ignore_unacked_for_failed_task():
+    c = _coord(n=3)
+    c.trigger(5, {}, async_write=False)
+    c.ack(5, 0)
+    ignored = c.ignore_unacked_for({2})
+    assert ignored == [5]
+    # Late acks for an ignored checkpoint never complete it.
+    c.ack(5, 1)
+    c.ack(5, 2)
+    assert c.latest_completed_id is None
+    # Re-trigger of an ignored id is a no-op.
+    c.trigger(5, {}, async_write=False)
+    c.ack_all(5)
+    assert c.latest_completed_id is None
+
+
+def test_backoff_and_reset():
+    c = _coord(n=1, base_interval_steps=16, backoff_multiplier=2.0,
+               max_backoff_steps=100)
+    assert c.interval_steps == 16
+    assert c.backoff() == 32
+    assert c.backoff() == 64
+    assert c.backoff() == 100
+    assert c.backoff() == 100
+    assert c.reset_interval() == 16
+
+
+def test_file_storage_roundtrip(tmp_path):
+    st = cp.FileCheckpointStorage(str(tmp_path))
+    carry = {"a": np.arange(5, dtype=np.int32), "b": np.ones((2, 2))}
+    st.write(cp.CompletedCheckpoint(3, carry, 0.0))
+    got = st.read(3)
+    np.testing.assert_array_equal(got.carry["a"], carry["a"])
+    assert st.list_ids() == [3]
+    st.delete(3)
+    assert st.list_ids() == []
+
+
+def test_restore_equivalence():
+    """A standby restored from a checkpoint and fed the same step inputs
+    reaches the bit-identical carry — the foundation of causal recovery."""
+    job = _job()
+    times = list(range(0, 100, 7))
+    ex1 = LocalExecutor(job, steps_per_epoch=3, seed=1)
+    ex1.time_source.now = lambda it=iter(times): next(it)
+    ex1.run_epoch()                         # epoch 0
+    coord = _coord(n=job.total_subtasks())
+    coord.trigger(0, ex1.carry, async_write=False)
+    coord.ack_all(0)
+    ex1.notify_checkpoint_complete(0)       # truncation on the live side
+    ex1.run_epoch()                         # epoch 1 (3 more steps)
+
+    ex2 = LocalExecutor(job, steps_per_epoch=3, seed=99)
+    ex2.restore(coord.latest_completed().carry, epoch_id=1)
+    ex2.notify_checkpoint_complete(0)
+    # Feed the standby the same post-checkpoint inputs the live run saw.
+    ex2.time_source.now = lambda it=iter(times[3:]): next(it)
+    # Match the live run's RNG stream position (3 draws pre-checkpoint).
+    ex2._rng = np.random.RandomState(1)
+    for _ in range(3):
+        ex2._rng.randint(0, 2 ** 31, dtype=np.int64)
+    ex2.run_epoch()
+
+    a = jax.device_get(ex1.carry)
+    b = jax.device_get(ex2.carry)
+    fa, _ = jax.tree_util.tree_flatten(a)
+    fb, _ = jax.tree_util.tree_flatten(b)
+    assert len(fa) == len(fb)
+    for xa, xb in zip(fa, fb):
+        np.testing.assert_array_equal(np.asarray(xa), np.asarray(xb))

@@ -1,0 +1,95 @@
+"""In-flight log: device ring, epoch truncation, spill files, replay
+iterator (reference inflightlogging package behaviors)."""
+
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from clonos_tpu.api import records
+from clonos_tpu.inflight import log as ifl
+
+
+P, CAP = 2, 4
+
+
+def _batch(step: int) -> records.RecordBatch:
+    k = np.full((P, CAP), step, np.int32)
+    v = np.arange(P * CAP, dtype=np.int32).reshape(P, CAP) + 100 * step
+    valid = np.ones((P, CAP), bool)
+    return records.RecordBatch(jnp.asarray(k), jnp.asarray(v),
+                               jnp.zeros((P, CAP), jnp.int32),
+                               jnp.asarray(valid))
+
+
+def test_ring_append_slice_truncate():
+    st = ifl.create(ring_steps=8, parallelism=P, capacity=CAP, max_epochs=8)
+    st = ifl.start_epoch(st, 0)
+    for i in range(3):
+        st = ifl.append_step(st, _batch(i))
+    st = ifl.start_epoch(st, 1)
+    for i in range(3, 5):
+        st = ifl.append_step(st, _batch(i))
+    assert int(ifl.size(st)) == 5
+    # Slice epoch 1's steps.
+    batch, count, start = ifl.slice_steps(st, ifl.epoch_start_step(st, 1), 4)
+    assert int(count) == 2 and int(start) == 3
+    np.testing.assert_array_equal(np.asarray(batch.keys[0]),
+                                  np.asarray(_batch(3).keys))
+    # Padding slots are zeroed.
+    assert int(jnp.sum(batch.valid[2:])) == 0
+    # Truncate epoch 0.
+    st = ifl.truncate(st, 0)
+    assert int(ifl.size(st)) == 2 and int(st.tail) == 3
+    assert not bool(ifl.overflowed(st))
+
+
+def test_ring_wraparound_preserves_live_steps():
+    st = ifl.create(ring_steps=4, parallelism=P, capacity=CAP, max_epochs=8)
+    st = ifl.start_epoch(st, 0)
+    for i in range(2):
+        st = ifl.append_step(st, _batch(i))
+    st = ifl.truncate(st, -1)  # no-op
+    st = ifl.start_epoch(st, 1)
+    st = ifl.truncate(st, 0)   # frees steps 0-1
+    for i in range(2, 6):      # wraps the ring
+        st = ifl.append_step(st, _batch(i))
+    assert not bool(ifl.overflowed(st))
+    batch, count, start = ifl.slice_steps(st, st.tail, 8)
+    assert int(count) == 4
+    np.testing.assert_array_equal(
+        np.asarray(batch.keys[:, 0, 0]), [2, 3, 4, 5, 0, 0, 0, 0])
+
+
+def test_spill_roundtrip_and_file_truncation(tmp_path):
+    log = ifl.SpillingInFlightLog(str(tmp_path), edge_id=0)
+    steps0 = jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs), *[_batch(i) for i in range(3)])
+    steps1 = jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs), *[_batch(i) for i in range(3, 5)])
+    log.spill_epoch(0, 0, steps0)
+    log.spill_epoch(1, 3, steps1)
+    log.drain()
+    assert os.path.exists(log._path(0)) and os.path.exists(log._path(1))
+    start, got = log.load_epoch(0)
+    assert start == 0
+    np.testing.assert_array_equal(np.asarray(got.keys),
+                                  np.asarray(steps0.keys))
+    log.truncate(0)
+    assert log.retained_epochs() == [1]
+    assert not os.path.exists(log._path(0))
+    log.close()
+
+
+def test_replay_iterator_order_and_skip(tmp_path):
+    log = ifl.SpillingInFlightLog(str(tmp_path), edge_id=1)
+    log.spill_epoch(0, 0, jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs), *[_batch(i) for i in range(3)]))
+    log.spill_epoch(1, 3, jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs), *[_batch(i) for i in range(3, 5)]))
+    log.drain()
+    got = [(s, int(np.asarray(b.keys)[0, 0]))
+           for s, b in ifl.ReplayIterator(log, 0, 1, skip_steps=1)]
+    assert got == [(1, 1), (2, 2), (3, 3), (4, 4)]
+    log.close()

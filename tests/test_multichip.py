@@ -1,0 +1,58 @@
+"""Multi-device execution: the job sharded over an 8-device mesh must
+compute bit-identically to the single-device program, with state actually
+distributed (the TaskManager-deployment analog; conftest forces 8 virtual
+CPU devices like the reference's MiniCluster forces in-JVM TMs)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from clonos_tpu.api.environment import StreamEnvironment
+from clonos_tpu.runtime.executor import CompiledJob, StepInputs
+
+
+def _job(parallelism):
+    env = StreamEnvironment(num_key_groups=32, default_edge_capacity=64)
+    (env.synthetic_source(vocab=17, batch_size=8, parallelism=parallelism)
+        .key_by().window_count(num_keys=17, window_size=1 << 30).sink())
+    return env.build()
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 devices")
+def test_mesh_execution_matches_single_device():
+    mesh = jax.sharding.Mesh(np.array(jax.devices()[:8]), ("tasks",))
+    job_m = _job(8)
+    cm = CompiledJob(job_m, log_capacity=1 << 9, max_epochs=8,
+                     inflight_ring_steps=8, mesh=mesh)
+    job_s = _job(8)
+    cs = CompiledJob(job_s, log_capacity=1 << 9, max_epochs=8,
+                     inflight_ring_steps=8, mesh=None)
+
+    inputs = StepInputs(jnp.asarray(3, jnp.int32), jnp.asarray(7, jnp.int32))
+    with mesh:
+        carry_m = jax.jit(cm.init_carry)()
+        step_m = jax.jit(cm.superstep)
+        for _ in range(3):
+            carry_m, out_m = step_m(carry_m, inputs)
+        jax.block_until_ready(carry_m)
+        # State is genuinely distributed across devices.
+        acc = carry_m.op_states[1]["acc"]
+        assert len(acc.sharding.device_set) == 8
+
+    carry_s = cs.init_carry()
+    step_s = jax.jit(cs.superstep)
+    for _ in range(3):
+        carry_s, out_s = step_s(carry_s, inputs)
+
+    fa, ta = jax.tree_util.tree_flatten(jax.device_get(carry_m))
+    fb, tb = jax.tree_util.tree_flatten(jax.device_get(carry_s))
+    assert ta == tb
+    for xa, xb in zip(fa, fb):
+        np.testing.assert_array_equal(np.asarray(xa), np.asarray(xb))
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 devices")
+def test_graft_dryrun_multichip():
+    import __graft_entry__ as ge
+    ge.dryrun_multichip(8)

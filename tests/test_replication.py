@@ -1,0 +1,113 @@
+"""Determinant replication: sharing-depth plan, step-boundary delta pull,
+offset dedup, lag catch-up, response merging (reference piggyback +
+DeterminantResponseEvent behaviors)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from clonos_tpu.api.operators import SyntheticSource
+from clonos_tpu.api.environment import StreamEnvironment
+from clonos_tpu.causal import log as clog
+from clonos_tpu.causal import replication as rep
+
+
+def _job(depth_chain=3, parallelism=2):
+    env = StreamEnvironment(num_key_groups=8)
+    s = env.synthetic_source(vocab=10, batch_size=4, parallelism=parallelism)
+    for i in range(depth_chain - 2):
+        s = s.key_by().reduce(num_keys=10, name=f"op{i}")
+    s.sink()
+    return env.build()
+
+
+def test_plan_respects_sharing_depth():
+    job = _job(depth_chain=4, parallelism=1)  # 4-vertex chain, p=1
+    full = rep.ReplicationPlan.from_job(job, sharing_depth=-1)
+    # Full sharing: every downstream vertex holds every upstream log.
+    assert (0, 3) in full.pairs and (0, 1) in full.pairs
+    d1 = rep.ReplicationPlan.from_job(job, sharing_depth=1)
+    assert (0, 1) in d1.pairs and (1, 2) in d1.pairs
+    assert (0, 2) not in d1.pairs and (0, 3) not in d1.pairs
+    # Upstream never holds downstream logs.
+    assert (1, 0) not in full.pairs
+
+
+def test_replication_pull_and_dedup():
+    # 2 owner logs, 3 replicas (r0,r1 of owner0; r2 of owner1).
+    owners = jax.vmap(lambda _: clog.create(64, 8))(jnp.arange(2))
+    rows = jnp.arange(2 * 5 * 8, dtype=jnp.int32).reshape(2, 5, 8)
+    owners = clog.v_append(owners, rows, jnp.asarray([5, 3]))
+    replicas = jax.vmap(lambda _: clog.create(64, 8))(jnp.arange(3))
+    owner_idx = jnp.asarray([0, 0, 1], jnp.int32)
+    replicas, lag = rep.replicate_step(replicas, owners, owner_idx, max_delta=8)
+    np.testing.assert_array_equal(np.asarray(lag), [0, 0, 0])
+    np.testing.assert_array_equal(np.asarray(replicas.head), [5, 5, 3])
+    # Replica contents equal owner prefix.
+    buf, count, _ = clog.v_slice_from(replicas, jnp.zeros(3, jnp.int32), 8)
+    np.testing.assert_array_equal(np.asarray(buf[0][:5]), np.asarray(rows[0]))
+    np.testing.assert_array_equal(np.asarray(buf[2][:3]),
+                                  np.asarray(rows[1][:3]))
+    # Second round with no new owner rows: no-op (dedup by offset).
+    replicas2, lag2 = rep.replicate_step(replicas, owners, owner_idx, 8)
+    np.testing.assert_array_equal(np.asarray(replicas2.head), [5, 5, 3])
+
+
+def test_replication_lag_catches_up():
+    owners = jax.vmap(lambda _: clog.create(64, 8))(jnp.arange(1))
+    rows = jnp.ones((1, 10, 8), jnp.int32)
+    owners = clog.v_append(owners, rows, jnp.asarray([10]))
+    replicas = jax.vmap(lambda _: clog.create(64, 8))(jnp.arange(1))
+    owner_idx = jnp.asarray([0], jnp.int32)
+    replicas, lag = rep.replicate_step(replicas, owners, owner_idx, max_delta=4)
+    assert int(lag[0]) == 6
+    replicas, lag = rep.replicate_step(replicas, owners, owner_idx, max_delta=4)
+    assert int(lag[0]) == 2
+    replicas, lag = rep.replicate_step(replicas, owners, owner_idx, max_delta=4)
+    assert int(lag[0]) == 0
+    assert int(replicas.head[0]) == 10
+
+
+def test_merge_determinant_responses():
+    full = np.arange(6 * 8, dtype=np.int32).reshape(6, 8)
+    a = (full[:4], 0)     # holder saw rows [0,4)
+    b = (full[2:6], 2)    # holder saw rows [2,6)
+    rows, start = rep.merge_determinant_responses([a, b])
+    assert start == 0
+    np.testing.assert_array_equal(rows, full)
+    # Divergent overlap is a protocol violation.
+    bad = (full[2:6] + 1, 2)
+    with pytest.raises(ValueError):
+        rep.merge_determinant_responses([a, bad])
+
+
+def test_truncated_owner_slice_serves_from_tail():
+    # After checkpoint truncation the owner only serves retained rows;
+    # replica that is already past the tail merges cleanly.
+    owners = jax.vmap(lambda _: clog.create(16, 8))(jnp.arange(1))
+    replicas = jax.vmap(lambda _: clog.create(16, 8))(jnp.arange(1))
+    owner_idx = jnp.asarray([0], jnp.int32)
+    owners = clog.v_start_epoch(owners, 0)
+    replicas = rep.sync_replica_epochs(replicas, 0)
+    owners = clog.v_append(owners, jnp.ones((1, 4, 8), jnp.int32),
+                           jnp.asarray([4]))
+    # Epoch fence: catch-up replication, then both sides record epoch 1.
+    replicas, lag = rep.replicate_step(replicas, owners, owner_idx, 16)
+    assert int(lag[0]) == 0
+    owners = clog.v_start_epoch(owners, 1)
+    replicas = rep.sync_replica_epochs(replicas, 1)
+    owners = clog.v_append(owners, 2 * jnp.ones((1, 4, 8), jnp.int32),
+                           jnp.asarray([4]))
+    replicas, _ = rep.replicate_step(replicas, owners, owner_idx, 16)
+    # Checkpoint 0 completes: truncate both sides.
+    owners = clog.v_truncate(owners, 0)
+    replicas = clog.v_truncate(replicas, 0)
+    replicas, lag = rep.replicate_step(replicas, owners, owner_idx, 16)
+    assert int(lag[0]) == 0
+    assert int(replicas.head[0]) == 8 and int(replicas.tail[0]) == 4
+    # Retained replica rows equal the owner's epoch-1 rows.
+    buf, count, start = clog.v_slice_from(replicas, replicas.tail, 8)
+    assert int(count[0]) == 4 and int(start[0]) == 4
+    np.testing.assert_array_equal(np.asarray(buf[0][:4]),
+                                  2 * np.ones((4, 8), np.int32))

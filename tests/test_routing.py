@@ -1,0 +1,114 @@
+"""Exchange routing vs a numpy oracle (reference partitioner semantics:
+KeyGroupStreamPartitioner / RebalancePartitioner / BroadcastPartitioner)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from clonos_tpu.api import records
+from clonos_tpu.parallel import routing
+
+
+def _np_hash32(x):
+    u = np.asarray(x, np.uint64) & 0xFFFFFFFF
+    u = ((u ^ (u >> 16)) * 0x7FEB352D) & 0xFFFFFFFF
+    u = ((u ^ (u >> 15)) * 0x846CA68B) & 0xFFFFFFFF
+    return (u ^ (u >> 16)) & 0xFFFFFFFF
+
+
+def _mkbatch(rows, cap):
+    """rows: list per upstream subtask of (key, val) lists."""
+    p = len(rows)
+    keys = np.zeros((p, cap), np.int32)
+    vals = np.zeros((p, cap), np.int32)
+    valid = np.zeros((p, cap), bool)
+    for i, r in enumerate(rows):
+        for j, (k, v) in enumerate(r):
+            keys[i, j], vals[i, j], valid[i, j] = k, v, True
+    return records.RecordBatch(jnp.asarray(keys), jnp.asarray(vals),
+                               jnp.zeros((p, cap), jnp.int32),
+                               jnp.asarray(valid))
+
+
+def test_hash32_matches_oracle():
+    xs = np.arange(-50, 50, dtype=np.int32)
+    got = np.asarray(routing.hash32(jnp.asarray(xs)))
+    want = _np_hash32(xs).astype(np.uint32)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_key_group_routing_owns_all_records():
+    G, P = 16, 4
+    batch = _mkbatch([[(k, k * 10) for k in range(5)],
+                      [(k, k) for k in range(7, 12)]], cap=8)
+    routed, dropped = routing.route_hash(batch, P, G, out_capacity=16)
+    assert int(dropped.sum()) == 0
+    # Every record lands on the subtask owning its key group.
+    out = []
+    for t in range(P):
+        lo, hi = routing.key_group_range(t, P, G)
+        row = records.to_numpy(
+            records.RecordBatch(routed.keys[t], routed.values[t],
+                                routed.timestamps[t], routed.valid[t]))
+        for k, v, _ in row:
+            kg = int(_np_hash32(k) % G)
+            assert lo <= kg < hi, (k, kg, t)
+            out.append((k, v))
+    assert sorted(out) == sorted((int(k), int(v)) for k, v, _ in
+                                 records.to_numpy(batch))
+
+
+def test_routing_preserves_arrival_order_within_target():
+    # All keys equal -> single target; order must match flattened input.
+    batch = _mkbatch([[(7, i) for i in range(4)],
+                      [(7, 10 + i) for i in range(4)]], cap=4)
+    routed, _ = routing.route_hash(batch, 2, 8, out_capacity=16)
+    t = int(routing.subtask_for_key_group(
+        routing.key_group(jnp.asarray([7]), 8), 2, 8)[0])
+    vals = [v for _, v, _ in records.to_numpy(
+        records.RecordBatch(routed.keys[t], routed.values[t],
+                            routed.timestamps[t], routed.valid[t]))]
+    assert vals == [0, 1, 2, 3, 10, 11, 12, 13]
+
+
+def test_overflow_drops_are_counted():
+    batch = _mkbatch([[(3, i) for i in range(6)]], cap=6)
+    routed, dropped = routing.route_hash(batch, 1, 4, out_capacity=4)
+    assert int(routed.valid.sum()) == 4
+    assert int(dropped.sum()) == 2
+
+
+def test_rebalance_round_robin_deterministic():
+    batch = _mkbatch([[(i, i) for i in range(6)]], cap=6)
+    routed, dropped = routing.route_rebalance(batch, 3, out_capacity=4)
+    assert int(dropped.sum()) == 0
+    per = [sorted(v for _, v, _ in records.to_numpy(
+        records.RecordBatch(routed.keys[t], routed.values[t],
+                            routed.timestamps[t], routed.valid[t])))
+           for t in range(3)]
+    assert per == [[0, 3], [1, 4], [2, 5]]
+    # offset shifts the cycle
+    routed2, _ = routing.route_rebalance(batch, 3, out_capacity=4, offset=1)
+    per2 = sorted(v for _, v, _ in records.to_numpy(
+        records.RecordBatch(routed2.keys[0], routed2.values[0],
+                            routed2.timestamps[0], routed2.valid[0])))
+    assert per2 == [2, 5]
+
+
+def test_broadcast_replicates_and_compacts():
+    batch = _mkbatch([[(1, 1)], [(2, 2)]], cap=3)
+    routed, dropped = routing.route_broadcast(batch, 3, out_capacity=4)
+    assert int(dropped.sum()) == 0
+    for t in range(3):
+        vals = sorted(v for _, v, _ in records.to_numpy(
+            records.RecordBatch(routed.keys[t], routed.values[t],
+                                routed.timestamps[t], routed.valid[t])))
+        assert vals == [1, 2]
+
+
+def test_forward_identity():
+    batch = _mkbatch([[(1, 5)], [(2, 6)]], cap=3)
+    routed, dropped = routing.route_forward(batch, out_capacity=3)
+    assert int(dropped.sum()) == 0
+    np.testing.assert_array_equal(np.asarray(routed.keys),
+                                  np.asarray(batch.keys))
