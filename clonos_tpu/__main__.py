@@ -1,0 +1,3 @@
+from clonos_tpu.cli import main
+
+raise SystemExit(main())

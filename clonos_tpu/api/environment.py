@@ -22,8 +22,9 @@ import dataclasses
 from typing import Callable, Optional
 
 from clonos_tpu.api.operators import (
-    FilterOperator, MapOperator, KeyedReduceOperator, Operator, SinkOperator,
-    SyntheticSource, TumblingWindowCountOperator,
+    FilterOperator, HostFeedSource, IntervalJoinOperator, KeyedReduceOperator,
+    MapOperator, Operator, SinkOperator, SyntheticSource,
+    TumblingWindowCountOperator, UnionOperator,
 )
 from clonos_tpu.graph.job_graph import JobGraph, JobVertex, PartitionType
 
@@ -89,6 +90,43 @@ class DataStream:
                                               window_size=window_size),
             parallelism)
 
+    def _attach2(self, other: "DataStream", name: str, op: Operator,
+                 parallelism: Optional[int],
+                 capacity: Optional[int] = None) -> "DataStream":
+        """Two-input attachment: edge order is (self=left, other=right)."""
+        p = parallelism or self._vertex.parallelism
+        v = self._env.graph.add_vertex(name, op, p)
+        cap = capacity or self._env.default_edge_capacity
+        for side in (self, other):
+            if side._keyed:
+                part = PartitionType.HASH
+            elif side._vertex.parallelism == p:
+                part = PartitionType.FORWARD
+            else:
+                part = PartitionType.REBALANCE
+            self._env.graph.add_edge(side._vertex, v, part, cap)
+        return DataStream(self._env, v)
+
+    def union(self, other: "DataStream", capacity: Optional[int] = None,
+              name: str = "union",
+              parallelism: Optional[int] = None) -> "DataStream":
+        cap = capacity or self._env.default_edge_capacity
+        return self._attach2(other, name, UnionOperator(capacity=cap),
+                             parallelism, cap)
+
+    def join(self, other: "DataStream", num_keys: int, window: int,
+             interval: int, capacity: Optional[int] = None,
+             name: str = "join",
+             parallelism: Optional[int] = None) -> "DataStream":
+        """Keyed interval join: self is the left (buffered) side, other the
+        right (probing) side. Both inputs must be key_by()'d."""
+        if not (self._keyed and other._keyed):
+            raise ValueError("join requires key_by() on both inputs")
+        cap = capacity or self._env.default_edge_capacity
+        op = IntervalJoinOperator(num_keys=num_keys, window=window,
+                                  interval=interval, capacity=cap)
+        return self._attach2(other, name, op, parallelism, cap)
+
     def rebalance(self) -> "DataStream":
         s = DataStream(self._env, self._vertex)
         s._force_rebalance = True
@@ -124,6 +162,13 @@ class StreamEnvironment:
             SyntheticSource(vocab=vocab, batch_size=batch_size,
                             rate_limit=rate_limit),
             parallelism, name)
+
+    def host_source(self, batch_size: int, parallelism: int = 1,
+                    name: str = "host-source") -> DataStream:
+        """Externally-fed source (register a FeedReader on the executor:
+        ``executor.register_feed(vertex_id, reader)``)."""
+        return self.source(HostFeedSource(batch_size=batch_size),
+                           parallelism, name)
 
     def build(self) -> JobGraph:
         self.graph.validate()

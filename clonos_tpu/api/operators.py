@@ -58,6 +58,28 @@ class Operator:
         raise NotImplementedError
 
 
+class TwoInputOperator(Operator):
+    """Base for vertices with two input edges (ConnectedStreams /
+    TwoInputStreamOperator analog, flink-streaming-java
+    .../api/operators/TwoInputStreamOperator.java).
+
+    TPU-first note on ORDER determinants: the reference logs which channel
+    each consumed buffer came from because its task threads race on input
+    queues (CausalBufferOrderService.java:48). The lockstep superstep
+    consumes BOTH inputs' pending batch every step, so the interleaving
+    nondeterminism is structurally eliminated — ``process2`` receives both
+    batches and any merge it performs is a pure function. The ORDER
+    determinant still records the (degenerate) selection for wire/protocol
+    parity."""
+
+    def process2(self, state: Any, left: RecordBatch, right: RecordBatch,
+                 ctx: OpContext) -> Tuple[Any, RecordBatch]:
+        raise NotImplementedError
+
+    def process(self, state, batch, ctx):
+        raise TypeError("TwoInputOperator requires process2 with two inputs")
+
+
 @dataclasses.dataclass
 class MapOperator(Operator):
     """Elementwise transform: fn(keys, values, timestamps) -> same triple.
@@ -203,6 +225,138 @@ class TumblingWindowCountOperator(Operator):
 
         acc, window, out = jax.vmap(one)(state["acc"], state["window"], batch)
         return {"acc": acc, "window": window}, out
+
+
+@dataclasses.dataclass
+class UnionOperator(TwoInputOperator):
+    """Merge two streams: left records first, then right, compacted into a
+    fixed output capacity (the union / ConnectedStreams.map-same-type
+    shape). Deterministic concatenation order replaces the reference's
+    arrival-order race."""
+
+    capacity: int
+
+    @property
+    def out_capacity(self):  # type: ignore[override]
+        return self.capacity
+
+    def process2(self, state, left, right, ctx):
+        def one(l: RecordBatch, r: RecordBatch):
+            keys = jnp.concatenate([l.keys, r.keys])
+            vals = jnp.concatenate([l.values, r.values])
+            ts = jnp.concatenate([l.timestamps, r.timestamps])
+            valid = jnp.concatenate([l.valid, r.valid])
+            # Compact valid records to the front (stable); anything past
+            # ``capacity`` live records is a (deterministic) overflow drop.
+            order = jnp.argsort(~valid, stable=True)
+            take = order[: self.capacity]
+            return zero_invalid(RecordBatch(
+                keys[take], vals[take], ts[take], valid[take]))
+        return state, jax.vmap(one)(left, right)
+
+
+@dataclasses.dataclass
+class IntervalJoinOperator(TwoInputOperator):
+    """Keyed stream-stream join (the NEXMark-style join shape,
+    BASELINE config #5; reference analog: IntervalJoinOperator /
+    flink-libraries join machinery re-imagined dense).
+
+    State per subtask: for each key, a ring of the last ``window`` left
+    records (value, timestamp). Each right record joins against all
+    retained left records of its key with |ts_l - ts_r| <= interval,
+    emitting (key, combine(vl, vr), ts_r). Dense tables: ``[P, K, W]``.
+    Emission capacity bounds matches per step (static shape; overflow
+    drops are deterministic)."""
+
+    num_keys: int
+    window: int               # retained left records per key
+    interval: int             # max |ts_left - ts_right|
+    capacity: int             # output capacity per subtask per step
+
+    @property
+    def out_capacity(self):  # type: ignore[override]
+        return self.capacity
+
+    def init_state(self, parallelism: int):
+        k, w = self.num_keys, self.window
+        return {
+            "lv": jnp.zeros((parallelism, k, w), jnp.int32),   # left values
+            "lt": jnp.zeros((parallelism, k, w), jnp.int32),   # left ts
+            "lm": jnp.zeros((parallelism, k, w), jnp.bool_),   # live mask
+            "cursor": jnp.zeros((parallelism, k), jnp.int32),  # ring cursor
+        }
+
+    def process2(self, state, left, right, ctx):
+        k, w, cap = self.num_keys, self.window, self.capacity
+
+        def one(lv, lt, lm, cursor, l: RecordBatch, r: RecordBatch):
+            # Insert left records into their key rings sequentially (a
+            # fori-style scan over the batch keeps per-key ring order).
+            def ins(carry, x):
+                lv, lt, lm, cursor = carry
+                key, val, ts, ok = x
+                slot = cursor[key] % w
+                lv = jnp.where(ok, lv.at[key, slot].set(val), lv)
+                lt = jnp.where(ok, lt.at[key, slot].set(ts), lt)
+                lm = jnp.where(ok, lm.at[key, slot].set(True), lm)
+                cursor = jnp.where(ok, cursor.at[key].add(1), cursor)
+                return (lv, lt, lm, cursor), 0
+
+            (lv, lt, lm, cursor), _ = jax.lax.scan(
+                ins, (lv, lt, lm, cursor),
+                (jnp.clip(l.keys, 0, k - 1), l.values, l.timestamps, l.valid))
+
+            # Join each right record against its key's ring: [B_r, W] pairs.
+            rk = jnp.clip(r.keys, 0, k - 1)
+            cand_v = lv[rk]                       # [B_r, W]
+            cand_t = lt[rk]
+            cand_m = lm[rk] & r.valid[:, None]
+            match = cand_m & (jnp.abs(cand_t - r.timestamps[:, None])
+                              <= self.interval)
+            out_keys = jnp.broadcast_to(r.keys[:, None], match.shape)
+            out_vals = cand_v + r.values[:, None]
+            out_ts = jnp.broadcast_to(r.timestamps[:, None], match.shape)
+            flat_n = match.size
+            fk = out_keys.reshape(flat_n)
+            fv = out_vals.reshape(flat_n)
+            ft = out_ts.reshape(flat_n)
+            fm = match.reshape(flat_n)
+            order = jnp.argsort(~fm, stable=True)
+            take = order[:cap]
+            live = fm[take]
+            return lv, lt, lm, cursor, zero_invalid(RecordBatch(
+                fk[take], fv[take], ft[take], live))
+
+        lv, lt, lm, cursor, out = jax.vmap(one)(
+            state["lv"], state["lt"], state["lm"], state["cursor"],
+            left, right)
+        return {"lv": lv, "lt": lt, "lm": lm, "cursor": cursor}, out
+
+
+@dataclasses.dataclass
+class HostFeedSource(Operator):
+    """Source fed by the host boundary (the Kafka/socket-source analog).
+
+    The executor passes the pulled batch in as this vertex's input batch;
+    the operator stamps timestamps and passes it through. Offset state
+    makes the checkpoint carry the feed position (the Kafka-offset-in-
+    checkpoint pattern); replay re-reads the same records from the
+    rewindable reader (reference: sources restore offsets and the causal
+    log pins the per-buffer cut counts)."""
+
+    batch_size: int
+
+    @property
+    def out_capacity(self):  # type: ignore[override]
+        return self.batch_size
+
+    def init_state(self, parallelism: int):
+        return {"offset": jnp.zeros((parallelism,), jnp.int32)}
+
+    def process(self, state, batch, ctx):
+        out = zero_invalid(batch._replace(
+            timestamps=jnp.where(batch.valid, ctx.time, 0)))
+        return {"offset": state["offset"] + out.count()}, out
 
 
 @dataclasses.dataclass

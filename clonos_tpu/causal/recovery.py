@@ -38,7 +38,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from clonos_tpu.api.operators import OpContext, Operator
+from clonos_tpu.api.operators import OpContext, Operator, TwoInputOperator
 from clonos_tpu.api.records import RecordBatch
 from clonos_tpu.causal import determinant as det
 from clonos_tpu.causal import log as clog
@@ -64,7 +64,11 @@ class ReplayPlan:
     subtask: int                    # subtask index within the vertex
     flat_subtask: int               # global flat id (log row)
     from_epoch: int                 # first lost epoch (checkpoint + 1 ...)
-    input_steps: Optional[RecordBatch]  # [n, cap] stacked lost input batches
+    #: stacked lost input batches, [n, cap] leaves — one RecordBatch for
+    #: single-input vertices (or re-read feed for HostFeedSource), a
+    #: (left, right) pair for TwoInputOperator vertices, None for
+    #: self-generating sources.
+    input_steps: Optional[Any]
     det_rows: np.ndarray            # int32[m, lanes] merged determinant rows
     det_start: int                  # absolute offset of det_rows[0]
     checkpoint_op_state: Any        # failed vertex's op state [P, ...] slice
@@ -128,8 +132,13 @@ class LogReplayer:
         # Operator state slice has leading dim 1 (the failed subtask alone);
         # operators are written over an arbitrary leading P dim, so the
         # same code replays one subtask that ran as one lane of P.
-        new_state, out = self.operator.process(
-            op_state, jax.tree_util.tree_map(lambda x: x[None], batch), ctx)
+        lift = lambda b: jax.tree_util.tree_map(lambda x: x[None], b)
+        if isinstance(self.operator, TwoInputOperator):
+            left, right = batch
+            new_state, out = self.operator.process2(
+                op_state, lift(left), lift(right), ctx)
+        else:
+            new_state, out = self.operator.process(op_state, lift(batch), ctx)
         return new_state, out.count()[0]
 
     #: per-step sync row layout (must match executor.DETS_PER_STEP appends)
@@ -191,6 +200,11 @@ class LogReplayer:
             z = jnp.zeros((n, cap), jnp.int32)
             inputs = RecordBatch(z, z, z, jnp.zeros((n, cap), jnp.bool_))
 
+        def _count_valid(b):
+            if isinstance(b, RecordBatch):
+                return int(np.asarray(b.valid).sum())
+            return sum(_count_valid(x) for x in b)
+
         state0 = jax.tree_util.tree_map(
             lambda x: x[plan.subtask][None], plan.checkpoint_op_state)
         subtasks = jnp.full((n,), plan.subtask, jnp.int32)
@@ -213,7 +227,7 @@ class LogReplayer:
         for i in range(n):
             rebuilt[ts_idx[i]: ts_idx[i] + k] = blocks[i]
 
-        consumed = (int(np.asarray(inputs.valid).sum())
+        consumed = (_count_valid(inputs)
                     if plan.input_steps is not None
                     else int(np.asarray(emit_counts).sum()))
         return ReplayResult(

@@ -1,0 +1,117 @@
+"""Command-line front end.
+
+Capability analog of the reference's client layer
+(flink-clients .../cli/CliFrontend.java:97 — run/info/list actions against
+a cluster). The TPU build is single-binary: the CLI builds/loads a job and
+drives the in-process ClusterRunner (MiniCluster-style), which is also the
+deployment model for one TPU host; multi-host runs launch the same
+entrypoint under ``jax.distributed`` (see parallel/distributed.py).
+
+Usage:
+    python -m clonos_tpu run <module:function> [--steps N] [--epochs N] ...
+    python -m clonos_tpu info <module:function>
+    python -m clonos_tpu bench
+    python -m clonos_tpu dryrun [--devices N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import json
+import sys
+import time
+
+
+def _load_job(spec: str):
+    """Load 'module.path:function' returning a JobGraph."""
+    mod_name, _, fn_name = spec.partition(":")
+    mod = importlib.import_module(mod_name)
+    fn = getattr(mod, fn_name or "build_job")
+    job = fn()
+    from clonos_tpu.graph.job_graph import JobGraph
+    if not isinstance(job, JobGraph):
+        raise TypeError(f"{spec} returned {type(job).__name__}, not JobGraph")
+    return job
+
+
+def cmd_run(args) -> int:
+    from clonos_tpu.runtime.cluster import ClusterRunner
+
+    job = _load_job(args.job)
+    runner = ClusterRunner(job, steps_per_epoch=args.steps_per_epoch,
+                           checkpoint_dir=args.checkpoint_dir)
+    t0 = time.monotonic()
+    for _ in range(args.epochs):
+        runner.run_epoch()
+        runner.watchdog.check()
+    dt = time.monotonic() - t0
+    snap = runner.metrics.snapshot()
+    print(json.dumps({"job": job.name, "epochs": args.epochs,
+                      "wall_s": round(dt, 3), "metrics": snap},
+                     default=str))
+    return 0
+
+
+def cmd_info(args) -> int:
+    job = _load_job(args.job)
+    info = {
+        "name": job.name,
+        "vertices": [
+            {"id": v.vertex_id, "name": v.name,
+             "operator": type(v.operator).__name__,
+             "parallelism": v.parallelism}
+            for v in job.vertices],
+        "edges": [
+            {"src": e.src, "dst": e.dst, "partition": e.partition.value,
+             "capacity": e.capacity}
+            for e in job.edges],
+        "num_key_groups": job.num_key_groups,
+        "sharing_depth": job.sharing_depth,
+        "total_subtasks": job.total_subtasks(),
+        "topological_order": job.topo_order(),
+    }
+    print(json.dumps(info, indent=2))
+    return 0
+
+
+def cmd_bench(args) -> int:
+    import bench
+    bench.main()
+    return 0
+
+
+def cmd_dryrun(args) -> int:
+    import __graft_entry__ as ge
+    ge.dryrun_multichip(args.devices)
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="clonos_tpu")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    pr = sub.add_parser("run", help="run a job to completion of N epochs")
+    pr.add_argument("job", help="module:function returning a JobGraph")
+    pr.add_argument("--epochs", type=int, default=4)
+    pr.add_argument("--steps-per-epoch", type=int, default=16)
+    pr.add_argument("--checkpoint-dir", default=None)
+    pr.set_defaults(fn=cmd_run)
+
+    pi = sub.add_parser("info", help="describe a job graph")
+    pi.add_argument("job")
+    pi.set_defaults(fn=cmd_info)
+
+    pb = sub.add_parser("bench", help="run the headline benchmark")
+    pb.set_defaults(fn=cmd_bench)
+
+    pd = sub.add_parser("dryrun", help="multichip sharding dry run")
+    pd.add_argument("--devices", type=int, default=8)
+    pd.set_defaults(fn=cmd_dryrun)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -122,10 +122,16 @@ class JobGraph:
         return sum(v.parallelism for v in self.vertices[:vertex_id])
 
     def validate(self) -> None:
+        from clonos_tpu.api.operators import TwoInputOperator
         self.topo_order()
         for v in self.vertices:
             ins = self.in_edges(v.vertex_id)
-            if len(ins) > 1:
-                raise NotImplementedError(
-                    f"vertex {v.name}: multi-input vertices land with the "
-                    f"two-input/join operator work")
+            two = isinstance(v.operator, TwoInputOperator)
+            if two and len(ins) != 2:
+                raise ValueError(
+                    f"vertex {v.name}: TwoInputOperator needs exactly 2 "
+                    f"input edges, has {len(ins)}")
+            if not two and len(ins) > 1:
+                raise ValueError(
+                    f"vertex {v.name}: single-input operator with "
+                    f"{len(ins)} input edges (use a TwoInputOperator)")

@@ -1,0 +1,140 @@
+"""Pallas TPU kernels for the causal-log hot path.
+
+SURVEY.md §7 marks the determinant log append as the #1 KERNEL (the
+reference's per-record JVM hot path, ThreadCausalLogImpl.appendDeterminant:
+158). The XLA fallback (causal/log.py append is a masked scatter) is
+correct everywhere; this kernel is the TPU-native fast path.
+
+Hardware constraint that shapes the design: TPU DMA and VMEM slicing
+operate at 128-lane-line granularity — a determinant row is 8 int32 lanes,
+so sub-line writes are impossible. The kernel therefore does a
+**line-grained read-modify-write**: an append of up to 16 rows touches at
+most two 128-lane lines of the ring; those lines are DMA'd HBM->VMEM,
+merged with the new rows by a one-hot matmul select (MXU-friendly gather),
+and DMA'd back — while the ring itself stays in HBM and is aliased in
+place. Total traffic per log per append: <= 2 lines in + 2 lines out
+(2 KiB), independent of ring capacity.
+
+Grid: one program per log (stacked [L, capacity, lanes] layout).
+``interpret=True`` runs the same kernel on CPU (tests)."""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from clonos_tpu.causal.determinant import NUM_LANES
+
+LINE = 128
+ROWS_PER_LINE = LINE // NUM_LANES          # 16 determinant rows per line
+MAX_APPEND_ROWS = ROWS_PER_LINE            # one line of new rows per call
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def ring_append_stacked(storage: jnp.ndarray, heads: jnp.ndarray,
+                        rows: jnp.ndarray, counts: jnp.ndarray,
+                        interpret: bool = False):
+    """Append ``counts[l]`` rows of ``rows[l]`` into ring ``storage[l]`` at
+    absolute offset ``heads[l]``. Returns (new_storage, new_heads).
+
+    storage: int32[L, capacity, NUM_LANES], capacity a power of two with
+             at least 2 lines (32 rows)
+    rows:    int32[L, max_batch, NUM_LANES], max_batch <= 16
+    """
+    L, capacity, lanes = storage.shape
+    max_batch = rows.shape[1]
+    if lanes != NUM_LANES or capacity & (capacity - 1):
+        raise ValueError("bad storage shape")
+    if max_batch > MAX_APPEND_ROWS:
+        raise ValueError(f"max_batch {max_batch} > {MAX_APPEND_ROWS}; split "
+                         f"the append")
+    n_lines = capacity // ROWS_PER_LINE
+    if n_lines < 2:
+        raise ValueError("capacity must be at least 2 lines (32 rows)")
+
+    flat = storage.reshape(L, n_lines, LINE)
+    rows_flat = jnp.pad(
+        rows, ((0, 0), (0, MAX_APPEND_ROWS - max_batch), (0, 0))
+    ).reshape(L, 1, LINE)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,                       # heads, counts
+        grid=(L,),
+        in_specs=[
+            pl.BlockSpec((1, 1, LINE), lambda l, *_: (l, 0, 0),
+                         memory_space=pltpu.VMEM),   # new rows, one line
+            pl.BlockSpec(memory_space=pltpu.ANY),    # ring (HBM, aliased)
+        ],
+        out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
+        scratch_shapes=[
+            pltpu.VMEM((2, LINE), jnp.int32),        # the touched lines
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )
+
+    def kernel(heads_ref, counts_ref, rows_vmem, ring_hbm, out_hbm,
+               scratch, sems):
+        l = pl.program_id(0)
+        head = heads_ref[l]
+        count = counts_ref[l]
+        head_mod = head & (capacity - 1)
+        line_a = head_mod // ROWS_PER_LINE
+        line_b = (line_a + 1) % n_lines
+
+        # Pull the two candidate lines into VMEM.
+        cp_a = pltpu.make_async_copy(
+            out_hbm.at[l, pl.ds(line_a, 1), :], scratch.at[pl.ds(0, 1), :],
+            sems.at[0])
+        cp_b = pltpu.make_async_copy(
+            out_hbm.at[l, pl.ds(line_b, 1), :], scratch.at[pl.ds(1, 1), :],
+            sems.at[1])
+        cp_a.start()
+        cp_b.start()
+        cp_a.wait()
+        cp_b.wait()
+
+        # Merge: scratch slot (j, c) is ring row line_j*16 + c//8, lane c%8.
+        # rel = that row's offset past head; rows with rel < count take the
+        # new value rows_flat[rel*8 + lane] — realized as a one-hot matmul
+        # (the MXU-shaped gather).
+        j_ids = jax.lax.broadcasted_iota(jnp.int32, (2, LINE), 0)
+        c_ids = jax.lax.broadcasted_iota(jnp.int32, (2, LINE), 1)
+        line_of = jnp.where(j_ids == 0, line_a, line_b)
+        ring_row = line_of * ROWS_PER_LINE + c_ids // NUM_LANES
+        rel = (ring_row - head_mod) & (capacity - 1)
+        take_new = rel < count
+        src_col = rel * NUM_LANES + c_ids % NUM_LANES      # [2, LINE]
+        src_col = jnp.where(take_new, src_col, 0)
+        onehot = (src_col[..., None]
+                  == jax.lax.broadcasted_iota(jnp.int32, (1, 1, LINE), 2))
+        new_line = rows_vmem[0, 0, :]                       # [LINE]
+        gathered = jnp.sum(onehot * new_line[None, None, :],
+                           axis=-1).astype(jnp.int32)       # [2, LINE]
+        scratch[:, :] = jnp.where(take_new, gathered, scratch[:, :])
+
+        # Write the lines back.
+        wb_a = pltpu.make_async_copy(
+            scratch.at[pl.ds(0, 1), :], out_hbm.at[l, pl.ds(line_a, 1), :],
+            sems.at[0])
+        wb_b = pltpu.make_async_copy(
+            scratch.at[pl.ds(1, 1), :], out_hbm.at[l, pl.ds(line_b, 1), :],
+            sems.at[1])
+        wb_a.start()
+        wb_b.start()
+        wb_a.wait()
+        wb_b.wait()
+
+    new_flat = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(flat.shape, flat.dtype),
+        # Positional over all operands (prefetch first): heads=0, counts=1,
+        # rows_flat=2, flat storage=3.
+        input_output_aliases={3: 0},
+        interpret=interpret,
+    )(heads, counts, rows_flat, flat)
+    return new_flat.reshape(L, capacity, NUM_LANES), heads + counts

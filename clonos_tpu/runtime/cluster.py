@@ -148,6 +148,21 @@ class ClusterRunner:
         self._fence_step[0] = 0
         self.plan = self.executor.compiled.plan
         self.reports: List[RecoveryReport] = []
+        # Observability (reference MetricRegistryImpl + Clonos determinant
+        # watchdog; see utils/metrics.py).
+        from clonos_tpu.utils import metrics as met
+        self.metrics = met.MetricRegistry()
+        g = self.metrics.group(f"job.{job.name}")
+        self._m_steps = g.counter("supersteps")
+        self._m_records = g.meter("records-per-sec")
+        self._m_epochs = g.counter("epochs")
+        self._m_ckpt_bytes = g.gauge(
+            "checkpoint.latest-bytes",
+            lambda: (self.standbys.latest.size_bytes
+                     if self.standbys.latest else 0))
+        self._m_recovery_ms = g.histogram("recovery.duration-ms")
+        self._m_recovered_records = g.counter("recovery.records-replayed")
+        self.watchdog = met.LogOccupancyWatchdog(self.executor, g)
 
     # --- steady state --------------------------------------------------------
 
@@ -164,10 +179,16 @@ class ClusterRunner:
                 f"call recover() first")
         closed = self.executor.epoch_id
         n = self.executor.steps_per_epoch - self.executor.step_in_epoch
+        rc_before = int(np.sum(np.asarray(
+            self.executor.carry.record_counts)))
         self.executor.run_epoch()
         self.global_step += n
         self._fence_step[self.executor.epoch_id] = self.global_step
         self.heartbeats.beat_all_except(self.failed)
+        self._m_steps.inc(n)
+        self._m_epochs.inc()
+        self._m_records.mark(int(np.sum(np.asarray(
+            self.executor.carry.record_counts))) - rc_before)
         # Checkpoint at the fence: snapshot is the post-roll carry.
         self.coordinator.trigger(closed, self.executor.carry,
                                  async_write=False)
@@ -179,6 +200,7 @@ class ClusterRunner:
             raise rec.RecoveryError("failed subtasks present; recover() first")
         self.executor.step()
         self.global_step += 1
+        self._m_steps.inc()
         self.heartbeats.beat_all_except(self.failed)
 
     # --- failure injection ---------------------------------------------------
@@ -303,10 +325,11 @@ class ClusterRunner:
                 rows, start = mgr.merged_determinants()
             total_dets += len(rows)
 
-            # InFlightLogRequest to the upstream ring of the input edge.
-            input_steps = None
-            if in_edges:
-                e = in_edges[0]
+            # InFlightLogRequest to the upstream ring(s) of the input
+            # edge(s); HostFeedSources instead re-read the rewindable
+            # external feed at the checkpointed offset with the recorded
+            # per-step counts (Kafka-offset-restore pattern).
+            def _ring_inputs(e: int):
                 el = live.edge_logs[e]
                 fence_off = int(ifl.epoch_start_step(el, from_epoch))
                 batch, cnt, s0 = ifl.slice_steps(
@@ -314,10 +337,22 @@ class ClusterRunner:
                 got = int(cnt)
                 if got < n_steps:
                     raise rec.RecoveryError(
-                        f"in-flight log of edge {e} lost steps: have {got}, "
-                        f"need {n_steps}")
-                input_steps = jax.tree_util.tree_map(
+                        f"in-flight log of edge {e} lost steps: have "
+                        f"{got}, need {n_steps}")
+                return jax.tree_util.tree_map(
                     lambda x: x[:n_steps, sub], batch)
+
+            from clonos_tpu.api.operators import (HostFeedSource,
+                                                  TwoInputOperator)
+            input_steps = None
+            if isinstance(v.operator, TwoInputOperator):
+                input_steps = (_ring_inputs(in_edges[0]),
+                               _ring_inputs(in_edges[1]))
+            elif in_edges:
+                input_steps = _ring_inputs(in_edges[0])
+            elif isinstance(v.operator, HostFeedSource) and n_steps > 0:
+                input_steps = self._reread_feed(vid, sub, ckpt_carry,
+                                                rows, n_steps)
 
             plan = rec.ReplayPlan(
                 vertex_id=vid, subtask=sub, flat_subtask=flat,
@@ -372,7 +407,37 @@ class ClusterRunner:
             recovery_ms=(_time.monotonic() - t0) * 1e3,
             managers=tuple(managers))
         self.reports.append(report)
+        self._m_recovery_ms.update(report.recovery_ms)
+        self._m_recovered_records.inc(report.records_replayed)
         return report
+
+    def _reread_feed(self, vid: int, sub: int, ckpt_carry: JobCarry,
+                     rows: np.ndarray, n_steps: int):
+        """Rebuild a HostFeedSource's lost input batches: offset from the
+        checkpointed operator state, per-step pull counts from the recorded
+        BUFFER_BUILT determinants, records from the rewindable reader."""
+        reader = self.executor.feed_readers.get(vid)
+        if reader is None:
+            raise rec.RecoveryError(
+                f"vertex {vid}: HostFeedSource has no registered feed "
+                f"reader to re-read from")
+        v = self.job.vertices[vid]
+        b = v.operator.batch_size
+        anchors = np.where((rows[:, det.LANE_TAG] == det.TIMESTAMP)
+                           & (rows[:, det.LANE_RC] == 0))[0][:n_steps]
+        counts = rows[anchors + 3, det.LANE_P].astype(np.int64)
+        offset = int(np.asarray(ckpt_carry.op_states[vid]["offset"][sub]))
+        keys = np.zeros((n_steps, b), np.int32)
+        vals = np.zeros((n_steps, b), np.int32)
+        valid = np.zeros((n_steps, b), bool)
+        for i, c in enumerate(counts):
+            ks, vs = reader.read_at(sub, offset, int(c))
+            keys[i, :int(c)], vals[i, :int(c)] = ks, vs
+            valid[i, :int(c)] = True
+            offset += int(c)
+        from clonos_tpu.api.records import RecordBatch as RB
+        return RB(jnp.asarray(keys), jnp.asarray(vals),
+                  jnp.zeros((n_steps, b), jnp.int32), jnp.asarray(valid))
 
     def _synthesize_det_rows(self, fence_global: int,
                              n_steps: int) -> np.ndarray:

@@ -37,7 +37,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from clonos_tpu.api.operators import OpContext
+from clonos_tpu.api.operators import (HostFeedSource, OpContext,
+                                      TwoInputOperator)
 from clonos_tpu.api.records import RecordBatch, empty, zero_invalid
 from clonos_tpu.causal import log as clog
 from clonos_tpu.causal import determinant as det
@@ -55,12 +56,15 @@ DETS_PER_STEP = 4
 
 
 class StepInputs(NamedTuple):
-    """Host-fed nondeterminism for one superstep (all int32 scalars). On the
-    live path these come from the causal services; during replay, from the
-    determinant log."""
+    """Host-fed inputs for one superstep. ``time``/``rng_bits`` are the
+    causal-service scalars (recorded as determinants; replayed from the
+    log). ``feeds`` carries one RecordBatch per HostFeedSource vertex (in
+    vertex-id order) — the external-system boundary (Kafka/socket analog);
+    replay re-reads them from the rewindable reader."""
 
     time: jnp.ndarray
     rng_bits: jnp.ndarray
+    feeds: Tuple[RecordBatch, ...] = ()
 
 
 class JobCarry(NamedTuple):
@@ -102,11 +106,19 @@ class CompiledJob:
     inflight_ring_steps: int = 64
     mesh: Optional[jax.sharding.Mesh] = None
     task_axis: str = "tasks"
+    #: determinant-append path: None = pallas kernel on TPU, XLA scatter
+    #: elsewhere; True/False forces. "interpret" runs the pallas kernel in
+    #: interpreter mode (CPU tests of the kernel path).
+    use_pallas_append: Optional[object] = None
 
     def __post_init__(self):
         self.job.validate()
         self.topo = self.job.topo_order()
         self.L = self.job.total_subtasks()
+        #: vertex ids of host-fed sources, in id order (StepInputs.feeds
+        #: positions align with this list).
+        self.feed_vertices = [v.vertex_id for v in self.job.vertices
+                              if isinstance(v.operator, HostFeedSource)]
         self.plan = rep.ReplicationPlan.from_job(self.job,
                                                  self.job.sharing_depth)
         self._owner_idx = self.plan.owner_index()
@@ -174,36 +186,43 @@ class CompiledJob:
             v = job.vertices[vid]
             p = v.parallelism
             in_edges = job.in_edges(vid)
-            if in_edges:
-                # Single-input vertices for now (validate() enforces); the
-                # consumed-channel choice is still logged as ORDER so the
-                # piggyback/replay machinery carries realistic load.
-                eidx = in_edges[0]
-                # Read the *previous* superstep's routed batch (depth-1
-                # pipeline): every vertex computes concurrently within a
-                # superstep, with no intra-step data dependency chain.
-                batch = carry.edge_bufs[eidx]
-                channel = jnp.zeros((), jnp.int32)
-            else:
-                cap = v.operator.out_capacity or 1
-                batch = empty((p, cap))
-                channel = jnp.zeros((), jnp.int32)
-
+            channel = jnp.zeros((), jnp.int32)
             ctx = OpContext(
                 time=inputs.time, epoch=jnp.zeros((), jnp.int32),
                 step=jnp.zeros((), jnp.int32), rng_bits=inputs.rng_bits,
                 subtask=jnp.arange(p, dtype=jnp.int32),
             )
-            consumed = batch.count() if in_edges else jnp.zeros((p,), jnp.int32)
-            state, out = v.operator.process(op_states[vid], batch, ctx)
+            # All edge reads take the *previous* superstep's routed batch
+            # (depth-1 pipeline): every vertex computes concurrently within
+            # a superstep, no intra-step data dependency chain.
+            if isinstance(v.operator, TwoInputOperator):
+                e0, e1 = in_edges
+                left, right = carry.edge_bufs[e0], carry.edge_bufs[e1]
+                consumed = left.count() + right.count()
+                state, out = v.operator.process2(
+                    op_states[vid], left, right, ctx)
+            else:
+                if in_edges:
+                    batch = carry.edge_bufs[in_edges[0]]
+                    consumed = batch.count()
+                elif vid in self.feed_vertices and inputs.feeds:
+                    # Host boundary: externally pulled records.
+                    batch = inputs.feeds[self.feed_vertices.index(vid)]
+                    consumed = batch.count()
+                else:
+                    cap = v.operator.out_capacity or 1
+                    batch = empty((p, cap))
+                    consumed = None
+                state, out = v.operator.process(op_states[vid], batch, ctx)
+                # Pure generators "consume" what they emit (their record
+                # count advances with generated records, like the
+                # reference's source loop).
+                if consumed is None:
+                    consumed = out.count()
             op_states[vid] = self._shard_tree(state)
             out = self._shard_tree(out)
             if in_edges and not job.out_edges(vid):
                 sinks[vid] = out
-            # Sources "consume" what they emit (their record count advances
-            # with generated records, like the reference's source loop).
-            if not in_edges:
-                consumed = out.count()
             consumed_parts[vid] = consumed
 
             # Determinants for this vertex's subtasks: one [P, 3, lanes]
@@ -254,7 +273,17 @@ class CompiledJob:
             [det_counts_parts[v.vertex_id] for v in job.vertices], axis=0)
         consumed_all = jnp.concatenate(
             [consumed_parts[v.vertex_id] for v in job.vertices], axis=0)
-        logs = clog.v_append(carry.logs, all_rows, all_counts)
+        mode = self.use_pallas_append
+        if mode is None:
+            mode = jax.default_backend() == "tpu" and self.mesh is None
+        if mode:
+            from clonos_tpu.ops.log_kernels import ring_append_stacked
+            new_rows, new_heads = ring_append_stacked(
+                carry.logs.rows, carry.logs.head, all_rows, all_counts,
+                interpret=(mode == "interpret"))
+            logs = carry.logs._replace(rows=new_rows, head=new_heads)
+        else:
+            logs = clog.v_append(carry.logs, all_rows, all_counts)
         logs = self._shard_tree(logs)
 
         # Piggyback replication round: pull every owner's fresh determinant
@@ -359,12 +388,45 @@ class LocalExecutor:
         # Epoch 0 starts at log offset 0 for every log.
         self.carry = self._jit_roll(self.carry, 0)
         self.step_input_history: List[Tuple[int, int]] = []
+        #: vid -> FeedReader for HostFeedSource vertices
+        self.feed_readers: Dict[int, Any] = {}
+
+    def register_feed(self, vertex_id: int, reader) -> None:
+        """Attach a rewindable reader (api/feeds.py) to a HostFeedSource
+        vertex — the external-system ingestion boundary."""
+        if vertex_id not in self.compiled.feed_vertices:
+            raise ValueError(f"vertex {vertex_id} is not a HostFeedSource")
+        self.feed_readers[vertex_id] = reader
+
+    def _pull_feeds(self) -> Tuple[RecordBatch, ...]:
+        from clonos_tpu.api.records import make as make_batch, empty as empty_batch
+        feeds = []
+        for vid in self.compiled.feed_vertices:
+            v = self.job.vertices[vid]
+            b = v.operator.batch_size
+            reader = self.feed_readers.get(vid)
+            if reader is None:
+                feeds.append(empty_batch((v.parallelism, b)))
+                continue
+            rows_k = np.zeros((v.parallelism, b), np.int32)
+            rows_v = np.zeros((v.parallelism, b), np.int32)
+            valid = np.zeros((v.parallelism, b), bool)
+            for s in range(v.parallelism):
+                ks, vs = reader.pull(s, b)
+                n = len(ks)
+                rows_k[s, :n], rows_v[s, :n], valid[s, :n] = ks, vs, True
+            feeds.append(RecordBatch(
+                jnp.asarray(rows_k), jnp.asarray(rows_v),
+                jnp.zeros((v.parallelism, b), jnp.int32),
+                jnp.asarray(valid)))
+        return tuple(feeds)
 
     def _next_inputs(self) -> StepInputs:
         t = self.time_source.now()
         r = int(self._rng.randint(0, 2 ** 31, dtype=np.int64))
         self.step_input_history.append((t, r))
-        return StepInputs(jnp.asarray(t, jnp.int32), jnp.asarray(r, jnp.int32))
+        return StepInputs(jnp.asarray(t, jnp.int32), jnp.asarray(r, jnp.int32),
+                          self._pull_feeds())
 
     def step(self) -> StepOutputs:
         """Run one superstep on the live path."""
@@ -378,9 +440,7 @@ class LocalExecutor:
         n = self.steps_per_epoch - self.step_in_epoch
         if n > 0:
             ins = [self._next_inputs() for _ in range(n)]
-            stacked = StepInputs(
-                jnp.stack([i.time for i in ins]),
-                jnp.stack([i.rng_bits for i in ins]))
+            stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *ins)
             self.carry, outs = self._jit_scan(self.carry, stacked)
         else:
             outs = None

@@ -1,0 +1,94 @@
+"""Processing-time timers with causal record/replay.
+
+Capability parity with the reference's timer machinery
+(flink-streaming-java .../runtime/tasks/SystemProcessingTimeService.java:50
+— implements ProcessingTimeForceable :79-114; each fired timer logs a
+TimerTriggerDeterminant {recordCount, callbackID, ts}; during replay timers
+are *forced* at the recorded record count :143,163).
+
+TPU split: timers are host-side control-plane events (they drive host
+callbacks — external flushes, window cleanup RPCs); on-device windows fire
+on causal time directly (operators.TumblingWindowCountOperator). The
+service checks due timers at superstep boundaries against causal time, so
+firing granularity is one superstep — which is also what makes replay
+exact: a fired timer's determinant records the step stamp and callback id.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import heapq
+from typing import Callable, Dict, List, Optional, Tuple
+
+from clonos_tpu.causal import determinant as det
+from clonos_tpu.causal.services import ReplayFeed
+
+
+class ProcessingTimeService:
+    """Per-task timer service.
+
+    Live: ``advance(now, stamp)`` fires every timer with fire_time <= now —
+    appending a TIMER_TRIGGER determinant and invoking the callback.
+    Replay: ``force_fire(d)`` re-fires a recovered TimerTriggerDeterminant
+    (reference ProcessingTimeForceable.forceFire), re-appending it so the
+    rebuilt log matches.
+    """
+
+    def __init__(self, append: Callable[[det.Determinant], None]):
+        self._append = append
+        self._heap: List[Tuple[int, int]] = []   # (fire_time, callback_id)
+        self._callbacks: Dict[int, Callable[[int], None]] = {}
+        self._next_id = 1
+
+    def register_callback(self, fn: Callable[[int], None],
+                          callback_id: Optional[int] = None) -> int:
+        """Callbacks must be re-registered under stable ids after restore
+        (ids are what the determinant records)."""
+        cid = callback_id if callback_id is not None else self._next_id
+        self._next_id = max(self._next_id, cid + 1)
+        self._callbacks[cid] = fn
+        return cid
+
+    def register_timer(self, fire_time: int, callback_id: int) -> None:
+        if callback_id not in self._callbacks:
+            raise ValueError(f"unknown callback id {callback_id}")
+        heapq.heappush(self._heap, (fire_time, callback_id))
+
+    def advance(self, now: int, stamp: int) -> int:
+        """Fire all due timers; returns count fired."""
+        fired = 0
+        while self._heap and self._heap[0][0] <= now:
+            ft, cid = heapq.heappop(self._heap)
+            d = det.TimerTriggerDeterminant(
+                record_count=max(stamp, 1), callback_id=cid, timestamp=ft)
+            self._append(d)
+            self._callbacks[cid](ft)
+            fired += 1
+        return fired
+
+    def force_fire(self, d: det.TimerTriggerDeterminant) -> None:
+        """Replay path: fire exactly the recorded timer (and drop its
+        pending registration if present, to avoid double fire)."""
+        self._heap = [(ft, cid) for ft, cid in self._heap
+                      if not (ft == d.timestamp and cid == d.callback_id)]
+        heapq.heapify(self._heap)
+        self._append(d)
+        cb = self._callbacks.get(d.callback_id)
+        if cb is None:
+            raise ValueError(
+                f"replayed timer references unregistered callback "
+                f"{d.callback_id}; re-register callbacks before replay")
+        cb(d.timestamp)
+
+    def replay_all(self, feed: ReplayFeed) -> int:
+        """Force-fire every recorded TIMER_TRIGGER determinant in order."""
+        n = 0
+        while not feed.exhausted():
+            d = feed.next_of(det.TimerTriggerDeterminant)
+            self.force_fire(d)
+            n += 1
+        return n
+
+    @property
+    def pending(self) -> int:
+        return len(self._heap)

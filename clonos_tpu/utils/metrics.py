@@ -1,0 +1,259 @@
+"""Metrics: registry, metric types, scopes, reporters.
+
+Capability parity with the reference's metrics system
+(flink-runtime .../metrics/MetricRegistryImpl.java:66, metric groups with
+job/task/operator scopes, pluggable reporters in flink-metrics-{jmx,
+prometheus,datadog,graphite,statsd,slf4j,dropwizard}) — scoped to what a
+single-process-control-plane framework needs: Counter/Gauge/Meter/Histogram,
+hierarchical scopes, and two reporters (logging, JSON-lines file; the
+prometheus-style text dump doubles as a scrape endpoint payload).
+
+Also carries the Clonos determinant-buffer watchdog analog
+(JobCausalLogImpl.java:268-298: a thread logging determinant pool occupancy
+every second) as :class:`LogOccupancyWatchdog` over the device log sizes.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+
+class Counter:
+    def __init__(self):
+        self._v = 0
+
+    def inc(self, n: int = 1) -> None:
+        self._v += n
+
+    @property
+    def value(self) -> int:
+        return self._v
+
+
+class Gauge:
+    """Wraps a supplier (evaluated at report time)."""
+
+    def __init__(self, fn: Callable[[], Any]):
+        self._fn = fn
+
+    @property
+    def value(self):
+        return self._fn()
+
+
+class Meter:
+    """Rate of events/sec over a sliding window."""
+
+    def __init__(self, window_s: float = 10.0, clock=time.monotonic):
+        self._events: List[tuple] = []
+        self._window = window_s
+        self._clock = clock
+
+    def mark(self, n: int = 1) -> None:
+        now = self._clock()
+        self._events.append((now, n))
+        cut = now - self._window
+        while self._events and self._events[0][0] < cut:
+            self._events.pop(0)
+
+    @property
+    def rate(self) -> float:
+        now = self._clock()
+        cut = now - self._window
+        total = sum(n for t, n in self._events if t >= cut)
+        return total / self._window
+
+
+class Histogram:
+    def __init__(self, max_samples: int = 1024):
+        self._buf: List[float] = []
+        self._max = max_samples
+
+    def update(self, v: float) -> None:
+        self._buf.append(v)
+        if len(self._buf) > self._max:
+            self._buf.pop(0)
+
+    def quantile(self, q: float) -> float:
+        if not self._buf:
+            return 0.0
+        return float(np.quantile(np.asarray(self._buf), q))
+
+    @property
+    def count(self) -> int:
+        return len(self._buf)
+
+    @property
+    def mean(self) -> float:
+        return float(np.mean(self._buf)) if self._buf else 0.0
+
+
+class MetricGroup:
+    """Hierarchical scope (job -> task -> operator naming)."""
+
+    def __init__(self, registry: "MetricRegistry", scope: str):
+        self._registry = registry
+        self.scope = scope
+
+    def counter(self, name: str) -> Counter:
+        return self._registry._register(f"{self.scope}.{name}", Counter())
+
+    def gauge(self, name: str, fn: Callable[[], Any]) -> Gauge:
+        return self._registry._register(f"{self.scope}.{name}", Gauge(fn))
+
+    def meter(self, name: str, window_s: float = 10.0) -> Meter:
+        return self._registry._register(f"{self.scope}.{name}",
+                                        Meter(window_s))
+
+    def histogram(self, name: str) -> Histogram:
+        return self._registry._register(f"{self.scope}.{name}", Histogram())
+
+    def add_group(self, name: str) -> "MetricGroup":
+        return MetricGroup(self._registry, f"{self.scope}.{name}")
+
+
+class MetricRegistry:
+    """Root registry (MetricRegistryImpl analog)."""
+
+    def __init__(self):
+        self._metrics: Dict[str, Any] = {}
+        self._reporters: List["Reporter"] = []
+        self._lock = threading.Lock()
+
+    def group(self, scope: str) -> MetricGroup:
+        return MetricGroup(self, scope)
+
+    def _register(self, full_name: str, metric):
+        with self._lock:
+            existing = self._metrics.get(full_name)
+            if existing is not None:
+                return existing
+            self._metrics[full_name] = metric
+            return metric
+
+    def add_reporter(self, reporter: "Reporter") -> None:
+        self._reporters.append(reporter)
+
+    def snapshot(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        with self._lock:
+            items = list(self._metrics.items())
+        for name, m in items:
+            if isinstance(m, Counter):
+                out[name] = m.value
+            elif isinstance(m, Gauge):
+                try:
+                    out[name] = m.value
+                except Exception as e:  # supplier died; report the fact
+                    out[name] = f"<gauge error: {e}>"
+            elif isinstance(m, Meter):
+                out[name] = round(m.rate, 3)
+            elif isinstance(m, Histogram):
+                out[name] = {"count": m.count, "mean": round(m.mean, 3),
+                             "p50": round(m.quantile(0.5), 3),
+                             "p99": round(m.quantile(0.99), 3)}
+        return out
+
+    def report(self) -> None:
+        snap = self.snapshot()
+        for r in self._reporters:
+            r.report(snap)
+
+    def prometheus_text(self) -> str:
+        """Prometheus exposition-format dump of scalar metrics."""
+        lines = []
+        for name, v in sorted(self.snapshot().items()):
+            metric = name.replace(".", "_").replace("-", "_")
+            if isinstance(v, (int, float)):
+                lines.append(f"{metric} {v}")
+            elif isinstance(v, dict):
+                for k2, v2 in v.items():
+                    lines.append(f"{metric}_{k2} {v2}")
+        return "\n".join(lines) + "\n"
+
+
+class Reporter:
+    def report(self, snapshot: Dict[str, Any]) -> None:
+        raise NotImplementedError
+
+
+class LoggingReporter(Reporter):
+    def __init__(self, log_fn: Callable[[str], None] = print):
+        self._log = log_fn
+
+    def report(self, snapshot: Dict[str, Any]) -> None:
+        self._log(json.dumps(snapshot, default=str))
+
+
+class JsonLinesReporter(Reporter):
+    """Appends one JSON object per report to a file (the scrape/ship
+    boundary for external systems)."""
+
+    def __init__(self, path: str, clock=time.time):
+        self._path = path
+        self._clock = clock
+
+    def report(self, snapshot: Dict[str, Any]) -> None:
+        rec = {"ts": self._clock(), **snapshot}
+        with open(self._path, "a") as f:
+            f.write(json.dumps(rec, default=str) + "\n")
+
+
+class ReporterThread:
+    """Periodic reporting driver (the registry's reporter scheduler)."""
+
+    def __init__(self, registry: MetricRegistry, interval_s: float = 1.0):
+        self._registry = registry
+        self._interval = interval_s
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._interval):
+            self._registry.report()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+
+class LogOccupancyWatchdog:
+    """Clonos determinant-buffer watchdog analog
+    (JobCausalLogImpl.java:268-298): samples causal-log occupancy and warns
+    as the ring approaches capacity."""
+
+    def __init__(self, executor, group: MetricGroup,
+                 warn_fraction: float = 0.8,
+                 warn_fn: Callable[[str], None] = print):
+        self._executor = executor
+        self._warn_fraction = warn_fraction
+        self._warn = warn_fn
+        group.gauge("causal-log.max-occupancy", self.max_occupancy)
+        group.gauge("causal-log.total-rows", self.total_rows)
+
+    def max_occupancy(self) -> float:
+        sizes = self._executor.log_sizes()
+        cap = self._executor.compiled.log_capacity
+        return float(sizes.max()) / cap if sizes.size else 0.0
+
+    def total_rows(self) -> int:
+        return int(self._executor.log_sizes().sum())
+
+    def check(self) -> bool:
+        occ = self.max_occupancy()
+        if occ >= self._warn_fraction:
+            self._warn(
+                f"causal log occupancy {occ:.0%} >= {self._warn_fraction:.0%}"
+                f" — checkpoint soon or determinants will be overwritten")
+            return True
+        return False

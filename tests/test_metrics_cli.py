@@ -1,0 +1,86 @@
+"""Observability + CLI front end (reference MetricRegistryImpl /
+CliFrontend analogs)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from clonos_tpu.utils import metrics as met
+
+
+def test_metric_types_and_snapshot():
+    reg = met.MetricRegistry()
+    g = reg.group("job.test")
+    c = g.counter("events")
+    c.inc(3)
+    g.gauge("level", lambda: 42)
+    h = g.histogram("latency")
+    for v in [1.0, 2.0, 3.0, 4.0]:
+        h.update(v)
+    t = [0.0]
+    m = met.Meter(window_s=10.0, clock=lambda: t[0])
+    reg._register("job.test.rate", m)
+    m.mark(50)
+    t[0] = 5.0
+    snap = reg.snapshot()
+    assert snap["job.test.events"] == 3
+    assert snap["job.test.level"] == 42
+    assert snap["job.test.latency"]["count"] == 4
+    assert snap["job.test.rate"] == 5.0
+    # Same name returns the same metric (no duplicate registration).
+    assert g.counter("events") is c
+    text = reg.prometheus_text()
+    assert "job_test_events 3" in text
+    assert "job_test_latency_p99" in text
+
+
+def test_jsonlines_reporter(tmp_path):
+    reg = met.MetricRegistry()
+    reg.group("a").counter("x").inc()
+    path = str(tmp_path / "metrics.jsonl")
+    reg.add_reporter(met.JsonLinesReporter(path, clock=lambda: 123.0))
+    reg.report()
+    reg.report()
+    lines = [json.loads(l) for l in open(path)]
+    assert len(lines) == 2 and lines[0]["a.x"] == 1 and lines[0]["ts"] == 123.0
+
+
+def test_cluster_metrics_and_watchdog():
+    from clonos_tpu.api.environment import StreamEnvironment
+    from clonos_tpu.runtime.cluster import ClusterRunner
+
+    env = StreamEnvironment(num_key_groups=8)
+    (env.synthetic_source(vocab=5, batch_size=4, parallelism=1)
+        .key_by().window_count(num_keys=5, window_size=1 << 30).sink())
+    r = ClusterRunner(env.build(), steps_per_epoch=2, log_capacity=1 << 6)
+    r.run_epoch()
+    snap = r.metrics.snapshot()
+    name = env.graph.name
+    assert snap[f"job.{name}.supersteps"] == 2
+    assert snap[f"job.{name}.epochs"] == 1
+    assert snap[f"job.{name}.checkpoint.latest-bytes"] > 0
+    assert 0 < snap[f"job.{name}.causal-log.total-rows"]
+    warnings = []
+    r.watchdog._warn = warnings.append
+    # 2 steps * 4 rows = 8 rows of 64 -> no warning yet.
+    assert not r.watchdog.check()
+    for _ in range(11):               # 8 + 44 = 52 rows >= 80% of 64
+        r.executor.step()
+    assert r.watchdog.check()
+    assert warnings and "occupancy" in warnings[0]
+
+
+def test_cli_info_and_run(capsys):
+    from clonos_tpu import cli
+    rc = cli.main(["info", "examples.wordcount:build_job"])
+    assert rc == 0
+    info = json.loads(capsys.readouterr().out)
+    assert info["name"] == "socket-window-wordcount"
+    assert info["total_subtasks"] == 12
+    rc = cli.main(["run", "examples.wordcount:build_job", "--epochs", "1",
+                   "--steps-per-epoch", "2"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["epochs"] == 1
+    assert out["metrics"][f"job.socket-window-wordcount.supersteps"] == 2
