@@ -60,10 +60,14 @@ def test_cluster_metrics_and_watchdog():
     assert snap[f"job.{name}.supersteps"] == 2
     assert snap[f"job.{name}.epochs"] == 1
     assert snap[f"job.{name}.checkpoint.latest-bytes"] > 0
-    assert 0 < snap[f"job.{name}.causal-log.total-rows"]
+    # The completed checkpoint truncated every log back to the fence.
+    assert snap[f"job.{name}.causal-log.total-rows"] == 0
+    # An epoch whose checkpoint stays pending keeps its rows live.
+    r.run_epoch(complete_checkpoint=False)
+    assert 0 < r.metrics.snapshot()[f"job.{name}.causal-log.total-rows"]
     warnings = []
     r.watchdog._warn = warnings.append
-    # 2 steps * 4 rows = 8 rows of 64 -> no warning yet.
+    # 2 retained steps * 4 rows = 8 rows of 64 -> no warning yet.
     assert not r.watchdog.check()
     for _ in range(11):               # 8 + 44 = 52 rows >= 80% of 64
         r.executor.step()
