@@ -44,8 +44,30 @@ class OpContext(NamedTuple):
     subtask: jnp.ndarray     # int32[P]: subtask indices (for vmapped ops)
 
 
+class BlockContext(NamedTuple):
+    """Step-batched context for :meth:`Operator.process_block`: the executor
+    hands operators a whole block of K supersteps at once so their work
+    compiles to a handful of large fused kernels instead of K small ones
+    (the decisive TPU cost model — per-kernel launch dwarfs per-element
+    work at stream batch sizes)."""
+
+    times: jnp.ndarray       # int32[K]: causal time per superstep
+    rng_bits: jnp.ndarray    # int32[K]: causal host-RNG draw per superstep
+    epoch: jnp.ndarray       # int32 scalar: epoch id of the block
+    step0: jnp.ndarray       # int32 scalar: global step index of block start
+    subtask: jnp.ndarray     # int32[P]
+
+    def at_step(self, k) -> OpContext:
+        return OpContext(time=self.times[k], epoch=self.epoch,
+                         step=self.step0 + jnp.asarray(k, jnp.int32),
+                         rng_bits=self.rng_bits[k], subtask=self.subtask)
+
+
 class Operator:
-    """Base operator. Subclasses override ``init_state``/``process``."""
+    """Base operator. Subclasses override ``init_state``/``process`` (the
+    per-superstep semantics) and, for the hot path, ``process_block`` (the
+    step-batched form, which must be bit-identical to scanning ``process``
+    — tests/test_operators_block.py enforces this for the stock library)."""
 
     #: output batch capacity per subtask per superstep; None = same as input.
     out_capacity: Optional[int] = None
@@ -56,6 +78,23 @@ class Operator:
     def process(self, state: Any, batch: RecordBatch,
                 ctx: OpContext) -> Tuple[Any, RecordBatch]:
         raise NotImplementedError
+
+    def process_block(self, state: Any, batches: RecordBatch,
+                      bctx: BlockContext) -> Tuple[Any, RecordBatch]:
+        """Advance K supersteps at once. ``batches`` has leading dims
+        ``[K, P, B]``; returns stacked outputs ``[K, P, out_cap]``.
+
+        Default: ``lax.scan`` over :meth:`process` — always correct, pays
+        per-step kernel costs; stock operators override with vectorized
+        forms (prefix sums over the step axis)."""
+        K = bctx.times.shape[0]
+
+        def step(st, xs):
+            b, k = xs
+            return self.process(st, b, bctx.at_step(k))
+
+        return jax.lax.scan(step, state,
+                            (batches, jnp.arange(K, dtype=jnp.int32)))
 
 
 class TwoInputOperator(Operator):
@@ -79,6 +118,19 @@ class TwoInputOperator(Operator):
     def process(self, state, batch, ctx):
         raise TypeError("TwoInputOperator requires process2 with two inputs")
 
+    def process_block(self, state: Any, batches: Tuple[RecordBatch,
+                                                       RecordBatch],
+                      bctx: BlockContext) -> Tuple[Any, RecordBatch]:
+        """``batches`` is a (left, right) pair of ``[K, P, B]`` stacks."""
+        K = bctx.times.shape[0]
+
+        def step(st, xs):
+            (l, r), k = xs
+            return self.process2(st, l, r, bctx.at_step(k))
+
+        return jax.lax.scan(step, state,
+                            (batches, jnp.arange(K, dtype=jnp.int32)))
+
 
 @dataclasses.dataclass
 class MapOperator(Operator):
@@ -92,6 +144,10 @@ class MapOperator(Operator):
         k, v, t = self.fn(batch.keys, batch.values, batch.timestamps)
         return state, zero_invalid(RecordBatch(k, v, t, batch.valid))
 
+    def process_block(self, state, batches, bctx):
+        # Stateless elementwise fn: applies to the whole [K, P, B] stack.
+        return self.process(state, batches, None)
+
 
 @dataclasses.dataclass
 class FilterOperator(Operator):
@@ -103,6 +159,9 @@ class FilterOperator(Operator):
     def process(self, state, batch, ctx):
         keep = batch.valid & self.pred(batch.keys, batch.values, batch.timestamps)
         return state, zero_invalid(batch._replace(valid=keep))
+
+    def process_block(self, state, batches, bctx):
+        return self.process(state, batches, None)
 
 
 @dataclasses.dataclass
@@ -144,6 +203,26 @@ class SyntheticSource(Operator):
         out = zero_invalid(RecordBatch(keys, jnp.ones((p, b), jnp.int32), ts, valid))
         return {"seq": state["seq"] + n}, out
 
+    def process_block(self, state, batches, bctx):
+        # The sequence counter advances by exactly n per step, so the whole
+        # block's keys are a closed form of (seq0, step index) — one kernel.
+        p = state["seq"].shape[0]
+        b = self.batch_size
+        K = bctx.times.shape[0]
+        n = b if self.rate_limit is None else min(b, self.rate_limit)
+        lane = jnp.arange(b, dtype=jnp.int32)
+        step = jnp.arange(K, dtype=jnp.int32)
+        seq = (state["seq"][None, :, None] + step[:, None, None] * n
+               + lane[None, None, :])                            # [K, P, B]
+        mix = seq * self.SUBTASK_STRIDE + bctx.subtask[None, :, None]
+        keys = (routing.hash32(mix) % jnp.uint32(self.vocab)).astype(jnp.int32)
+        valid = jnp.broadcast_to(lane[None, None, :] < n, (K, p, b))
+        ts = jnp.broadcast_to(bctx.times[:, None, None], (K, p, b)
+                              ).astype(jnp.int32)
+        out = zero_invalid(RecordBatch(keys, jnp.ones((K, p, b), jnp.int32),
+                                       ts, valid))
+        return {"seq": state["seq"] + n * K}, out
+
 
 @dataclasses.dataclass
 class KeyedReduceOperator(Operator):
@@ -169,13 +248,42 @@ class KeyedReduceOperator(Operator):
             # scatter; restrict to associative+commutative reduce_fn (doc'd).
             contrib = jnp.zeros_like(acc).at[b.keys].add(
                 jnp.where(b.valid, b.values, 0), mode="drop")
-            touched = jnp.zeros(acc.shape, jnp.bool_).at[b.keys].set(
-                b.valid, mode="drop")
+            # A key is touched iff any VALID record carries it; scatter-add
+            # of the mask (scatter-set with duplicate keys is unordered —
+            # an invalid record zeroed to key 0 must not untouch key 0).
+            touched = jnp.zeros(acc.shape, jnp.int32).at[b.keys].add(
+                b.valid.astype(jnp.int32), mode="drop") > 0
             new_acc = jnp.where(touched, self.reduce_fn(acc, contrib), acc)
             out_vals = jnp.where(b.valid, new_acc[b.keys], 0)
             return new_acc, zero_invalid(b._replace(values=out_vals))
         new_acc, out = jax.vmap(one)(state["acc"], batch)
         return {"acc": new_acc}, out
+
+    def process_block(self, state, batches, bctx):
+        # Vectorized form is exact only for the additive default (the prefix
+        # over steps must distribute); other reduce_fns take the scan path.
+        if self.reduce_fn is not jnp.add:
+            return super().process_block(state, batches, bctx)
+        K, p, _ = batches.keys.shape
+        nk = self.num_keys
+        acc0 = state["acc"]                               # [P, nk]
+        step = jnp.broadcast_to(jnp.arange(K, dtype=jnp.int32)[:, None, None],
+                                batches.keys.shape)
+        sub = jnp.broadcast_to(jnp.arange(p, dtype=jnp.int32)[None, :, None],
+                               batches.keys.shape)
+        contrib = jnp.zeros((K, p, nk), jnp.int32).at[
+            step, sub, batches.keys].add(
+                jnp.where(batches.valid, batches.values, 0), mode="drop")
+        cum = jnp.cumsum(contrib, axis=0)                 # inclusive prefix
+        acc_end = acc0[None] + cum                        # [K, P, nk]
+        out_vals = jnp.where(
+            batches.valid,
+            jnp.take_along_axis(
+                acc_end.reshape(K * p, nk),
+                batches.keys.reshape(K * p, -1), axis=1
+            ).reshape(batches.keys.shape), 0)
+        return ({"acc": acc0 + cum[-1]},
+                zero_invalid(batches._replace(values=out_vals)))
 
 
 @dataclasses.dataclass
@@ -226,6 +334,54 @@ class TumblingWindowCountOperator(Operator):
         acc, window, out = jax.vmap(one)(state["acc"], state["window"], batch)
         return {"acc": acc, "window": window}, out
 
+    def process_block(self, state, batches, bctx):
+        # Step-batched form: window-id evolution is a running max of the
+        # per-step window ids; accumulator segments between fires are
+        # differences of an inclusive prefix sum; the emission at a fire
+        # step is the segment ending at the previous step. All exact int32.
+        K, p, _ = batches.keys.shape
+        nk = self.num_keys
+        size = self.window_size
+        w_now = (bctx.times // size).astype(jnp.int32)            # [K]
+        w0 = state["window"]                                      # [P]
+        acc0 = state["acc"]                                       # [P, nk]
+        rm = jax.lax.associative_scan(jnp.maximum, w_now)         # incl [K]
+        neg_inf = jnp.asarray(-(2 ** 31) + 1, jnp.int32)
+        rm_excl = jnp.concatenate([neg_inf[None], rm[:-1]])
+        window_pre = jnp.maximum(w0[None, :], rm_excl[:, None])   # [K, P]
+        fire = w_now[:, None] > window_pre                        # [K, P]
+
+        step = jnp.broadcast_to(jnp.arange(K, dtype=jnp.int32)[:, None, None],
+                                batches.keys.shape)
+        sub = jnp.broadcast_to(jnp.arange(p, dtype=jnp.int32)[None, :, None],
+                               batches.keys.shape)
+        contrib = jnp.zeros((K, p, nk), jnp.int32).at[
+            step, sub, batches.keys].add(
+                jnp.where(batches.valid, batches.values, 0), mode="drop")
+        cum = jnp.cumsum(contrib, axis=0)                         # [K, P, nk]
+        cum_excl = cum - contrib
+
+        kidx = jnp.arange(K, dtype=jnp.int32)[:, None]
+        lf = jax.lax.associative_scan(                            # [K, P]
+            jnp.maximum, jnp.where(fire, kidx, -1), axis=0)
+        lf_c = jnp.broadcast_to(jnp.clip(lf, 0, K - 1)[:, :, None],
+                                (K, p, nk))
+        seg_base = jnp.take_along_axis(cum_excl, lf_c, axis=0)
+        acc_end = jnp.where(lf[:, :, None] >= 0, cum - seg_base,
+                            acc0[None] + cum)                     # [K, P, nk]
+        emit = jnp.concatenate([acc0[None], acc_end[:-1]], axis=0)
+
+        keys = jnp.broadcast_to(jnp.arange(nk, dtype=jnp.int32)[None, None, :],
+                                (K, p, nk))
+        window_end = (window_pre + 1) * size                      # [K, P]
+        out = zero_invalid(RecordBatch(
+            keys=keys, values=emit,
+            timestamps=jnp.broadcast_to(window_end[:, :, None], (K, p, nk)
+                                        ).astype(jnp.int32),
+            valid=fire[:, :, None] & (emit != 0)))
+        return ({"acc": acc_end[-1],
+                 "window": jnp.maximum(w0, rm[-1])}, out)
+
 
 @dataclasses.dataclass
 class UnionOperator(TwoInputOperator):
@@ -253,6 +409,16 @@ class UnionOperator(TwoInputOperator):
             return zero_invalid(RecordBatch(
                 keys[take], vals[take], ts[take], valid[take]))
         return state, jax.vmap(one)(left, right)
+
+    def process_block(self, state, batches, bctx):
+        # Stateless: flatten [K, P] into one vmapped batch dim.
+        left, right = batches
+        K, p = left.keys.shape[:2]
+        rs = lambda b: jax.tree_util.tree_map(
+            lambda x: x.reshape((K * p,) + x.shape[2:]), b)
+        _, out = self.process2(state, rs(left), rs(right), None)
+        return state, jax.tree_util.tree_map(
+            lambda x: x.reshape((K, p) + x.shape[1:]), out)
 
 
 @dataclasses.dataclass
@@ -358,6 +524,11 @@ class HostFeedSource(Operator):
             timestamps=jnp.where(batch.valid, ctx.time, 0)))
         return {"offset": state["offset"] + out.count()}, out
 
+    def process_block(self, state, batches, bctx):
+        out = zero_invalid(batches._replace(
+            timestamps=jnp.where(batches.valid, bctx.times[:, None, None], 0)))
+        return ({"offset": state["offset"] + out.count().sum(axis=0)}, out)
+
 
 @dataclasses.dataclass
 class SinkOperator(Operator):
@@ -371,3 +542,7 @@ class SinkOperator(Operator):
     def process(self, state, batch, ctx):
         return ({"emitted": state["emitted"] + batch.count()},
                 zero_invalid(batch))
+
+    def process_block(self, state, batches, bctx):
+        out = zero_invalid(batches)
+        return ({"emitted": state["emitted"] + out.count().sum(axis=0)}, out)

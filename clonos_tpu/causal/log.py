@@ -124,6 +124,19 @@ def append(state: ThreadLogState, rows: jnp.ndarray, count) -> ThreadLogState:
     return state._replace(rows=new_rows, head=state.head + count)
 
 
+def append_full(state: ThreadLogState, rows: jnp.ndarray) -> ThreadLogState:
+    """Append ALL rows of ``[n, NUM_LANES]`` at head — the block-fence bulk
+    path (n is static and <= capacity, so ring positions are unique and the
+    scatter needs no masking or read-back of current rows)."""
+    n = rows.shape[0]
+    if n > state.capacity:
+        raise ValueError(f"bulk append of {n} rows > capacity {state.capacity}")
+    pos = (state.head + jnp.arange(n, dtype=jnp.int32)) & (state.capacity - 1)
+    return state._replace(rows=state.rows.at[pos].set(rows,
+                                                      unique_indices=True),
+                          head=state.head + n)
+
+
 def append_one(state: ThreadLogState, row: jnp.ndarray) -> ThreadLogState:
     """Append a single row (hot path inside a traced step)."""
     pos = state.head & (state.capacity - 1)
@@ -226,6 +239,7 @@ def sync_epoch_index(state: ThreadLogState, epoch_id) -> ThreadLogState:
 # maps).
 
 v_append = jax.vmap(append)
+v_append_full = jax.vmap(append_full)
 v_merge_delta = jax.vmap(merge_delta)
 v_slice_from = jax.vmap(slice_from, in_axes=(0, 0, None))
 v_truncate = jax.vmap(truncate, in_axes=(0, None))

@@ -8,19 +8,21 @@ dedup in ThreadCausalLogImpl.processUpstreamDelta:117, sharing-depth cut in
 JobCausalLogImpl.respondToDeterminantRequest:192 and the serde's
 insertNewUpstreamLog:165-193).
 
-TPU-native re-design: replication is a **step-boundary collective**, not a
+TPU-native re-design: replication is a **block-boundary collective**, not a
 per-message payload. Every (owner subtask -> holder subtask) pair within the
 sharing-depth cut is one row of a stacked replica log
-``int32[R, capacity, lanes]``. One fused op per superstep:
+``int32[R, capacity, lanes]``. The executor's block program appends the
+same determinant tensor to owners and (owner-indexed) replicas in one fused
+gather+scatter — replica heads therefore equal owner heads *by
+construction* at every block fence, and the determinants describing a
+block's outputs are on their holders before those outputs become externally
+visible (the piggyback guarantee, NettyMessage.java:156-242). Under pjit
+over a device mesh the owner-indexed gather lowers to the ICI all-gather
+this design targets (SURVEY.md §2.6).
 
-    delta  = gather owner rows [replica_head[r] : owner_head[owner(r)])
-    merge  = vmapped offset-dedup append into the replica stack
-
-Because a replica's ``head`` *is* its consumer offset into the owner's
-absolute offset space, the dedup of the reference's processUpstreamDelta
-falls out of merge_delta for free. Under pjit over a device mesh the gather
-by owner index lowers to the ICI all-gather this design targets
-(SURVEY.md §2.6: piggyback -> fused collective on step boundaries).
+:func:`replicate_step` (pull + offset-dedup merge) remains the
+*resynchronization* path — recovery catch-up and reconnect-after-gap —
+mirroring the reference's processUpstreamDelta dedup semantics.
 
 Transitive sharing: the reference relays a remote log's delta hop-by-hop;
 here the sharing mask already contains every (owner, holder) pair within
@@ -46,17 +48,28 @@ class ReplicationPlan:
     """Static description of who replicates whose log.
 
     ``pairs[r] = (owner_flat, holder_flat)`` over flat subtask indices
-    (JobGraph.subtask_base layout). Owner/holder subtask pairing is the
-    full bipartite product of the vertices' subtasks — a superset of the
-    reference's channel-wise propagation with identical recoverability.
+    (JobGraph.subtask_base layout).
+
+    ``replication_factor`` bounds how many holder *subtasks* per
+    (owner subtask, holder vertex) pair carry a replica: holder subtask
+    ``(owner_sub + j) % P_holder`` for ``j < factor``. ``-1`` = every
+    holder subtask (the reference's behavior, where every downstream TM
+    within sharing depth accumulates the log via piggybacking —
+    JobCausalLogImpl.java:71 keyed by CausalLogID with one copy per TM).
+    A bounded factor is the memory-scalable default: the full bipartite
+    product is O(V^2·P^2) log copies, structurally impossible at the
+    128-task BASELINE configs; factor k survives any k-1 failures among
+    an owner's chosen holders (plus arbitrary other failures), and k=P
+    restores reference-equivalent redundancy.
     """
 
     pairs: Tuple[Tuple[int, int], ...]
     num_subtasks: int
+    replication_factor: int = -1
 
     @classmethod
-    def from_job(cls, job: JobGraph, sharing_depth: int = -1
-                 ) -> "ReplicationPlan":
+    def from_job(cls, job: JobGraph, sharing_depth: int = -1,
+                 replication_factor: int = -1) -> "ReplicationPlan":
         info = job.graph_info(0)
         mask = info.sharing_mask(sharing_depth)
         pairs: List[Tuple[int, int]] = []
@@ -66,10 +79,13 @@ class ReplicationPlan:
                     continue
                 ob = job.subtask_base(owner_v)
                 hb = job.subtask_base(holder_v)
+                hp = job.vertices[holder_v].parallelism
+                k = hp if replication_factor < 0 else min(replication_factor,
+                                                          hp)
                 for os_ in range(job.vertices[owner_v].parallelism):
-                    for hs in range(job.vertices[holder_v].parallelism):
-                        pairs.append((ob + os_, hb + hs))
-        return cls(tuple(pairs), job.total_subtasks())
+                    for j in range(k):
+                        pairs.append((ob + os_, hb + (os_ + j) % hp))
+        return cls(tuple(pairs), job.total_subtasks(), replication_factor)
 
     @property
     def num_replicas(self) -> int:

@@ -96,6 +96,21 @@ def append_step(state: EdgeLogState, batch: RecordBatch) -> EdgeLogState:
         head=state.head + 1)
 
 
+def append_block(state: EdgeLogState, block: RecordBatch) -> EdgeLogState:
+    """Log a whole block of K steps' batches ([K, P, cap] leaves) in one
+    scatter — the block-executor bulk path. K must be <= ring_steps (the
+    executor enforces this), so ring positions are unique."""
+    K = block.keys.shape[0]
+    idx = (state.head + jnp.arange(K, dtype=jnp.int32)) & (state.ring_steps - 1)
+    return state._replace(
+        keys=state.keys.at[idx].set(block.keys, unique_indices=True),
+        values=state.values.at[idx].set(block.values, unique_indices=True),
+        timestamps=state.timestamps.at[idx].set(block.timestamps,
+                                                unique_indices=True),
+        valid=state.valid.at[idx].set(block.valid, unique_indices=True),
+        head=state.head + K)
+
+
 def start_epoch(state: EdgeLogState, epoch_id) -> EdgeLogState:
     return start_epoch_at(state, epoch_id, state.head)
 

@@ -67,9 +67,9 @@ def ring_append_stacked(storage: jnp.ndarray, heads: jnp.ndarray,
         in_specs=[
             pl.BlockSpec((1, 1, LINE), lambda l, *_: (l, 0, 0),
                          memory_space=pltpu.VMEM),   # new rows, one line
-            pl.BlockSpec(memory_space=pltpu.ANY),    # ring (HBM, aliased)
+            pl.BlockSpec(memory_space=pl.ANY),    # ring (HBM, aliased)
         ],
-        out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
         scratch_shapes=[
             pltpu.VMEM((2, LINE), jnp.int32),        # the touched lines
             pltpu.SemaphoreType.DMA((2,)),

@@ -14,27 +14,27 @@ reference's JobMaster-side machinery:
                              standby through the recovery FSM (§3.4)
 
 Failure model (TPU deployment semantics): the unit of loss is a subtask's
-device-resident state — its operator-state slice, its thread causal log row,
-and the replica rows it holds for others. In-flight edge rings are owned by
-the *producing* vertex (they are its output subpartition logs, exactly the
-reference's PipelinedSubpartition ownership) and are modeled as surviving a
-single-subtask loss (vertex-level redundancy across the producer's devices);
-the BUFFER_BUILT verification in replay additionally proves the producer
-could rebuild them bit-identically (reference buildAndLogBuffer:536-571) —
-the round-2 refinement is per-producer-subtask ring shards.
+device-resident state — its operator-state slice, its thread causal log
+row, the replica rows it holds for others, AND its shard of its vertex's
+in-flight output ring (the producer's subpartition log dies with the
+producer, exactly the reference's PipelinedSubpartition ownership).
+Recovery rebuilds the lost ring shard from the replayed operator's
+re-emitted batches — reconstruction, not just verification (reference
+buildAndLogBuffer, PipelinedSubpartition.java:536-599).
 
 "Local recovery instead of global rollback" (README.md:13-20): healthy
 subtasks are never rolled back — the failed subtask alone is rebuilt from
 the last checkpoint plus determinant replay, then patched into the live
 carry. The proof obligation (and the test): the patched carry is
-bit-identical to a never-failed run.
+bit-identical to a never-failed run on the canonical (logically-live)
+state — executor.canonical_carry.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import time as _time
-from typing import Dict, List, Optional, Sequence, Set, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -44,11 +44,12 @@ from clonos_tpu.causal import determinant as det
 from clonos_tpu.causal import log as clog
 from clonos_tpu.causal import recovery as rec
 from clonos_tpu.causal import replication as rep
-from clonos_tpu.graph.job_graph import JobGraph
+from clonos_tpu.graph.job_graph import JobGraph, PartitionType
 from clonos_tpu.inflight import log as ifl
+from clonos_tpu.parallel import routing
 from clonos_tpu.runtime import checkpoint as cp
 from clonos_tpu.runtime.executor import (DETS_PER_STEP, JobCarry,
-                                         LocalExecutor)
+                                         LeanSnapshot, LocalExecutor)
 
 
 class HeartbeatMonitor:
@@ -115,6 +116,11 @@ class RecoveryReport:
     ignored_checkpoints: Tuple[int, ...]
     recovery_ms: float
     managers: Tuple[rec.RecoveryManager, ...]
+
+
+class OverflowError_(RuntimeError):
+    """An un-checkpointed log/ring overflow was detected — the state is no
+    longer recoverable and the control plane must not keep running."""
 
 
 class ClusterRunner:
@@ -189,8 +195,14 @@ class ClusterRunner:
         self._m_epochs.inc()
         self._m_records.mark(int(np.sum(np.asarray(
             self.executor.carry.record_counts))) - rc_before)
-        # Checkpoint at the fence: snapshot is the post-roll carry.
-        self.coordinator.trigger(closed, self.executor.carry,
+        # Overflow guards at every roll: an un-truncated ring that wrapped
+        # has silently clobbered recovery state — fail loudly, never limp.
+        violations = self.executor.check_overflow()
+        if violations:
+            raise OverflowError_("; ".join(violations))
+        # Checkpoint at the fence: the lean fence snapshot (op state +
+        # offsets; logs/rings are truncated on completion, not persisted).
+        self.coordinator.trigger(closed, self.executor.lean_snapshot(),
                                  async_write=False)
         if complete_checkpoint:
             self.coordinator.ack_all(closed)
@@ -206,11 +218,13 @@ class ClusterRunner:
     # --- failure injection ---------------------------------------------------
 
     def inject_failure(self, flat_subtasks: Sequence[int]) -> None:
-        """Kill subtasks: zero their device state (operator slice, causal
-        log row, held replica rows) — the information a lost device takes
-        with it. (Fault-injection API the reference delegates to Jepsen,
-        flink-jepsen/.)"""
+        """Kill subtasks: zero their device state — operator slice, causal
+        log row, held replica rows, and their shard of the vertex's
+        in-flight output ring (the producer's subpartition log dies with
+        the producer). (Fault-injection API the reference delegates to
+        Jepsen, flink-jepsen/.)"""
         carry = self.executor.carry
+        compiled = self.executor.compiled
         for flat in flat_subtasks:
             self.failed.add(flat)
             self.heartbeats.mark_dead(flat)
@@ -222,8 +236,7 @@ class ClusterRunner:
             ops = list(carry.op_states)
             ops[vid] = op
             # Causal log row -> fresh.
-            fresh = clog.create(self.executor.compiled.log_capacity,
-                                self.executor.compiled.max_epochs)
+            fresh = clog.create(compiled.log_capacity, compiled.max_epochs)
             logs = jax.tree_util.tree_map(
                 lambda s, f: s.at[flat].set(f), carry.logs, fresh)
             # Replica rows held by the dead subtask -> fresh.
@@ -231,8 +244,20 @@ class ClusterRunner:
             for r in self.plan.replicas_held_by(flat):
                 replicas = jax.tree_util.tree_map(
                     lambda s, f: s.at[r].set(f), replicas, fresh)
+            # The producer's in-flight ring shard -> zeros (content only;
+            # offsets are vertex-uniform and survive on the control plane).
+            rings = list(carry.out_rings)
+            if vid in compiled.ring_index:
+                ri = compiled.ring_index[vid]
+                el = rings[ri]
+                rings[ri] = el._replace(
+                    keys=el.keys.at[:, sub].set(0),
+                    values=el.values.at[:, sub].set(0),
+                    timestamps=el.timestamps.at[:, sub].set(0),
+                    valid=el.valid.at[:, sub].set(False))
             carry = carry._replace(
                 op_states=tuple(ops), logs=logs, replicas=replicas,
+                out_rings=tuple(rings),
                 record_counts=carry.record_counts.at[flat].set(0))
         self.executor.carry = carry
 
@@ -249,14 +274,20 @@ class ClusterRunner:
         return self.heartbeats.expired()
 
     def recover(self) -> RecoveryReport:
-        """Run the full causal-recovery protocol for all failed subtasks."""
+        """Run the full causal-recovery protocol for all failed subtasks,
+        in topological order (an upstream's reconstructed ring shard feeds
+        its downstream's replay — the reference's staged
+        WaitingConnections/in-flight-request ordering)."""
         if not self.failed:
             raise rec.RecoveryError("no failed subtasks")
         if not self.standbys.has_state():
             raise rec.RecoveryError(
                 "no completed checkpoint to restore standbys from")
         t0 = _time.monotonic()
-        failed = tuple(sorted(self.failed))
+        topo_pos = {vid: i for i, vid in
+                    enumerate(self.executor.compiled.topo)}
+        failed = tuple(sorted(
+            self.failed, key=lambda f: (topo_pos[self._vertex_of(f)[0]], f)))
 
         # (1) RunStandbyTaskStrategy.onTaskFailure: ignore checkpoints the
         # dead tasks never acked; back off the checkpoint interval.
@@ -267,20 +298,20 @@ class ClusterRunner:
         from_epoch = ckpt.checkpoint_id + 1
         fence = self._fence_step[from_epoch]
         n_steps = self.global_step - fence
+        snap: LeanSnapshot = jax.tree_util.tree_map(jnp.asarray, ckpt.carry)
         managers: List[rec.RecoveryManager] = []
         total_dets = 0
         total_records = 0
 
-        live = self.executor.carry
-        ckpt_carry = jax.tree_util.tree_map(jnp.asarray, ckpt.carry)
-        patched = live
+        patched = self.executor.carry
 
         for flat in failed:
             vid, sub = self._vertex_of(flat)
             v = self.job.vertices[vid]
             mgr = rec.RecoveryManager(
                 vid, sub, flat,
-                rec.LogReplayer(v.operator, v.parallelism))
+                rec.LogReplayer(v.operator, v.parallelism,
+                                block_steps=self.executor.block_steps))
             managers.append(mgr)
             in_edges = self.job.in_edges(vid)
             out_edges = self.job.out_edges(vid)
@@ -302,8 +333,8 @@ class ClusterRunner:
                 if out_edges:
                     raise rec.RecoveryError(
                         f"subtask {flat}: no surviving replica holds its "
-                        f"determinant log (sharing depth too shallow for "
-                        f"this failure pattern)")
+                        f"determinant log (sharing depth / replication "
+                        f"factor too shallow for this failure pattern)")
                 # Pure sink: nobody downstream replicates its log. Its
                 # inputs replay exactly from the upstream ring; its own
                 # nondeterminism (time/rng step inputs) is re-synthesized
@@ -313,52 +344,43 @@ class ClusterRunner:
                 synthesized = True
             mgr.expect_determinant_responses(len(holders))
             for r, _h in holders:
-                one = jax.tree_util.tree_map(lambda x: x[r], live.replicas)
+                one = jax.tree_util.tree_map(lambda x: x[r], patched.replicas)
                 buf, count, start = clog.get_determinants(
                     one, from_epoch, max_out=self._det_request_max())
                 mgr.notify_determinant_response(
                     np.asarray(buf)[: int(count)], int(start))
             if synthesized:
                 rows = self._synthesize_det_rows(fence, n_steps)
-                start = int(np.asarray(ckpt_carry.logs.head[flat]))
+                start = int(np.asarray(snap.log_heads[flat]))
             else:
                 rows, start = mgr.merged_determinants()
             total_dets += len(rows)
 
-            # InFlightLogRequest to the upstream ring(s) of the input
-            # edge(s); HostFeedSources instead re-read the rewindable
-            # external feed at the checkpointed offset with the recorded
-            # per-step counts (Kafka-offset-restore pattern).
-            def _ring_inputs(e: int):
-                el = live.edge_logs[e]
-                fence_off = int(ifl.epoch_start_step(el, from_epoch))
-                batch, cnt, s0 = ifl.slice_steps(
-                    el, fence_off, max(n_steps, 1))
-                got = int(cnt)
-                if got < n_steps:
-                    raise rec.RecoveryError(
-                        f"in-flight log of edge {e} lost steps: have "
-                        f"{got}, need {n_steps}")
-                return jax.tree_util.tree_map(
-                    lambda x: x[:n_steps, sub], batch)
-
+            # Lost inputs: the checkpointed edge buffer (the depth-1 batch
+            # spanning the fence) + the upstream rings' raw outputs,
+            # re-routed through the deterministic exchange. Upstream ring
+            # shards zeroed by a connected failure were rebuilt earlier in
+            # this loop (topological order).
             from clonos_tpu.api.operators import (HostFeedSource,
                                                   TwoInputOperator)
             input_steps = None
             if isinstance(v.operator, TwoInputOperator):
-                input_steps = (_ring_inputs(in_edges[0]),
-                               _ring_inputs(in_edges[1]))
+                input_steps = (
+                    self._replay_inputs(patched, snap, in_edges[0], sub,
+                                        fence, n_steps),
+                    self._replay_inputs(patched, snap, in_edges[1], sub,
+                                        fence, n_steps))
             elif in_edges:
-                input_steps = _ring_inputs(in_edges[0])
+                input_steps = self._replay_inputs(patched, snap, in_edges[0],
+                                                  sub, fence, n_steps)
             elif isinstance(v.operator, HostFeedSource) and n_steps > 0:
-                input_steps = self._reread_feed(vid, sub, ckpt_carry,
-                                                rows, n_steps)
+                input_steps = self._reread_feed(vid, sub, snap, rows, n_steps)
 
             plan = rec.ReplayPlan(
                 vertex_id=vid, subtask=sub, flat_subtask=flat,
                 from_epoch=from_epoch, input_steps=input_steps,
                 det_rows=rows, det_start=start,
-                checkpoint_op_state=ckpt_carry.op_states[vid],
+                checkpoint_op_state=snap.op_states[vid],
                 n_steps=n_steps, verify_outputs=not synthesized)
             result = mgr.run_replay(plan)
             total_records += result.records_replayed
@@ -372,27 +394,18 @@ class ClusterRunner:
                     f"subtask {flat}: replayed determinant stream diverges "
                     f"from the recovered log")
 
-            patched = self._patch(patched, ckpt_carry, vid, sub, flat,
-                                  result, rebuilt, from_epoch)
+            patched = self._patch(patched, snap, vid, sub, flat,
+                                  result, rebuilt, from_epoch, fence, n_steps)
 
-        # Replica rows held by revived subtasks: restore from checkpoint and
-        # let one catch-up replication round pull them level.
+        # Replica rows held by revived subtasks: replicas are identical to
+        # their owner's log by construction (same bulk appends), so rebuild
+        # by copying the owner's (possibly just-restored) log row.
         for flat in failed:
             for r in self.plan.replicas_held_by(flat):
+                o = self.plan.pairs[r][0]
                 patched = patched._replace(replicas=jax.tree_util.tree_map(
-                    lambda s, c: s.at[r].set(c[r]),
-                    patched.replicas, ckpt_carry.replicas))
-        if any(self.plan.replicas_held_by(f) for f in failed):
-            # Snapshot predates the completion truncation; re-apply (no-op
-            # for rows already truncated — truncate never moves backwards).
-            patched = patched._replace(
-                replicas=clog.v_truncate(patched.replicas, from_epoch - 1))
-        if self.plan.num_replicas > 0:
-            replicas, _ = rep.replicate_step(
-                patched.replicas, patched.logs,
-                self.executor.compiled._owner_idx,
-                max_delta=self._det_request_max())
-            patched = patched._replace(replicas=replicas)
+                    lambda s, l: s.at[r].set(l[o]),
+                    patched.replicas, patched.logs))
 
         self.executor.carry = patched
         for flat in failed:
@@ -411,7 +424,98 @@ class ClusterRunner:
         self._m_recovered_records.inc(report.records_replayed)
         return report
 
-    def _reread_feed(self, vid: int, sub: int, ckpt_carry: JobCarry,
+    # --- input reconstruction ------------------------------------------------
+
+    def _ring_steps(self, patched: JobCarry, src_vid: int, start: int,
+                    n: int):
+        """Raw output steps [start, start+n) of a producer vertex, from the
+        device ring — falling back to the host spill for steps the ring no
+        longer retains (reference SpilledReplayIterator.java:61)."""
+        compiled = self.executor.compiled
+        ri = compiled.ring_index[src_vid]
+        el = patched.out_rings[ri]
+        batch, cnt, s0 = ifl.slice_steps(el, start, n)
+        got_start = int(s0)
+        if got_start <= start and int(cnt) >= (start - got_start) + n:
+            return jax.tree_util.tree_map(
+                lambda x: x[start - got_start: start - got_start + n], batch)
+        # Ring shortfall: pull missing leading steps from the spill.
+        if self.executor.spill_logs is None:
+            raise rec.RecoveryError(
+                f"in-flight log of vertex {src_vid} lost steps "
+                f"[{start}, {got_start}) and spill is disabled")
+        spill = self.executor.spill_logs[ri]
+        missing = got_start - start
+        parts = []
+        have = start
+        for ep in spill.retained_epochs():
+            ep_start, ep_batch = spill.load_epoch(ep)
+            ep_n = ep_batch.keys.shape[0]
+            lo = max(have, ep_start)
+            hi = min(start + n, ep_start + ep_n, got_start)
+            if hi > lo:
+                parts.append(jax.tree_util.tree_map(
+                    lambda x: x[lo - ep_start: hi - ep_start], ep_batch))
+                have = hi
+            if have >= got_start:
+                break
+        if have < min(got_start, start + n):
+            raise rec.RecoveryError(
+                f"vertex {src_vid}: spill does not cover steps "
+                f"[{have}, {got_start})")
+        if int(cnt) > 0 and got_start < start + n:
+            parts.append(jax.tree_util.tree_map(
+                lambda x: x[: start + n - got_start], batch))
+        out = jax.tree_util.tree_map(
+            lambda *xs: jnp.concatenate(xs, axis=0), *parts)
+        if out.keys.shape[0] != n:
+            raise rec.RecoveryError(
+                f"vertex {src_vid}: reconstructed {out.keys.shape[0]} of "
+                f"{n} in-flight steps")
+        return out
+
+    def _replay_inputs(self, patched: JobCarry, snap: LeanSnapshot,
+                       eidx: int, sub: int, fence: int, n_steps: int):
+        """The failed consumer's lost inputs on edge ``eidx``: the
+        checkpointed depth-1 edge buffer (its input at the first lost step)
+        followed by the upstream's ring outputs [fence, fence+n-1), routed
+        through the deterministic exchange."""
+        e = self.job.edges[eidx]
+        first = jax.tree_util.tree_map(
+            lambda x: x[sub][None], snap.edge_bufs[eidx])
+        if n_steps <= 1:
+            return first if n_steps == 1 else jax.tree_util.tree_map(
+                lambda x: x[:0], first)
+        raw = self._ring_steps(patched, e.src, fence, n_steps - 1)
+        routed = self._route_block(eidx, raw, snap)
+        routed_sub = jax.tree_util.tree_map(lambda x: x[:, sub], routed)
+        return jax.tree_util.tree_map(
+            lambda a, b: jnp.concatenate([a, b], axis=0), first, routed_sub)
+
+    def _route_block(self, eidx: int, raw, snap: LeanSnapshot):
+        """Re-run the exchange for a block of raw producer outputs — the
+        replay-side of 'exchanges are deterministic, so the network needs
+        no determinants' (parallel/routing.py)."""
+        e = self.job.edges[eidx]
+        dst_p = self.job.vertices[e.dst].parallelism
+        if e.partition == PartitionType.HASH:
+            r, _ = jax.vmap(lambda b: routing.route_hash(
+                b, dst_p, self.job.num_key_groups, e.capacity))(raw)
+        elif e.partition == PartitionType.FORWARD:
+            r, _ = jax.vmap(lambda b: routing.route_forward(
+                b, e.capacity))(raw)
+        elif e.partition == PartitionType.REBALANCE:
+            counts = raw.count().sum(axis=1)
+            offs = (jnp.asarray(snap.rr_offsets[eidx][0], jnp.int32)
+                    + jnp.cumsum(counts) - counts)
+            r, _ = jax.vmap(lambda b, o: routing.route_rebalance(
+                b, dst_p, e.capacity, o))(raw, offs)
+        else:
+            r, _ = jax.vmap(lambda b: routing.route_broadcast(
+                b, dst_p, e.capacity))(raw)
+        return r
+
+    def _reread_feed(self, vid: int, sub: int, snap: LeanSnapshot,
                      rows: np.ndarray, n_steps: int):
         """Rebuild a HostFeedSource's lost input batches: offset from the
         checkpointed operator state, per-step pull counts from the recorded
@@ -426,7 +530,7 @@ class ClusterRunner:
         anchors = np.where((rows[:, det.LANE_TAG] == det.TIMESTAMP)
                            & (rows[:, det.LANE_RC] == 0))[0][:n_steps]
         counts = rows[anchors + 3, det.LANE_P].astype(np.int64)
-        offset = int(np.asarray(ckpt_carry.op_states[vid]["offset"][sub]))
+        offset = int(np.asarray(snap.op_states[vid]["offset"][sub]))
         keys = np.zeros((n_steps, b), np.int32)
         vals = np.zeros((n_steps, b), np.int32)
         valid = np.zeros((n_steps, b), bool)
@@ -463,54 +567,80 @@ class ClusterRunner:
         return rows
 
     def _det_request_max(self) -> int:
-        return 4 * DETS_PER_STEP * max(self.executor.steps_per_epoch, 1) * \
-            max(len(self._fence_step), 2)
+        # A replica can never serve more rows than its ring retains.
+        return self.executor.compiled.log_capacity
 
-    def _patch(self, carry: JobCarry, ckpt_carry: JobCarry, vid: int,
+    def _patch(self, carry: JobCarry, snap: LeanSnapshot, vid: int,
                sub: int, flat: int, result: rec.ReplayResult,
-               det_rows: np.ndarray, from_epoch: int) -> JobCarry:
+               det_rows: np.ndarray, from_epoch: int, fence: int,
+               n_steps: int) -> JobCarry:
         """Graft the rebuilt subtask back into the live carry."""
+        compiled = self.executor.compiled
         # Operator state slice.
         ops = list(carry.op_states)
         ops[vid] = jax.tree_util.tree_map(
             lambda live_x, new_x: live_x.at[sub].set(new_x[0]),
             ops[vid], result.op_state)
-        # Causal log row: checkpoint-fence log + recovered rows appended.
-        ck_row = jax.tree_util.tree_map(lambda x: x[flat], ckpt_carry.logs)
+        # Causal log row: an empty log re-based at the fence offset (the
+        # pre-fence rows were truncated by the completed checkpoint — the
+        # lean snapshot deliberately doesn't carry them) + recovered rows.
+        ck_head = int(np.asarray(snap.log_heads[flat]))
+        base = jnp.asarray(ck_head, jnp.int32)
+        restored = clog.create(compiled.log_capacity, compiled.max_epochs)
+        restored = restored._replace(head=base, tail=base)
         n = det_rows.shape[0]
         if n > 0:
-            restored = clog.append(ck_row, jnp.asarray(det_rows), n)
-        else:
-            restored = ck_row
-        # Epoch->offset index entries recorded after the fence died with the
-        # task; rebuild them from the fence-step ledger. Sync blocks anchor
-        # at TIMESTAMP rows (async rows may interleave, shifting offsets;
-        # an async row appended in the roll gap attributes to the new epoch
-        # here — one-row truncation skew at worst, conservative side).
-        ck_head = int(np.asarray(ckpt_carry.logs.head[flat]))
+            restored = clog.append(restored, jnp.asarray(det_rows), n)
+        # Epoch->offset index entries died with the task; rebuild them from
+        # the fence-step ledger. Sync blocks anchor at TIMESTAMP rows.
         ts_pos = (np.where((det_rows[:, det.LANE_TAG] == det.TIMESTAMP)
                            & (det_rows[:, det.LANE_RC] == 0))[0]
                   if n > 0 else np.zeros((0,), np.int64))
-        fence_global = self._fence_step[from_epoch]
-        for e in range(from_epoch + 1, self.executor.epoch_id + 1):
+        for e in range(from_epoch, self.executor.epoch_id + 1):
             if e in self._fence_step:
-                step_i = self._fence_step[e] - fence_global
-                off = (ck_head + int(ts_pos[step_i])
-                       if step_i < len(ts_pos)
-                       else ck_head + n)
+                step_i = self._fence_step[e] - fence
+                # from_epoch starts exactly at the checkpointed head (async
+                # rows appended in the roll gap come after the fence);
+                # later fences anchor at their first step's TIMESTAMP row
+                # (one-row skew if an async row landed in that roll gap —
+                # conservative side, matches round-1 semantics).
+                if step_i == 0:
+                    off = ck_head
+                elif step_i < len(ts_pos):
+                    off = ck_head + int(ts_pos[step_i])
+                else:
+                    off = ck_head + n
                 slot = e % restored.max_epochs
                 restored = restored._replace(
                     epoch_starts=restored.epoch_starts.at[slot].set(off),
                     latest_epoch=jnp.maximum(
                         restored.latest_epoch,
                         jnp.asarray(e, jnp.int32)))
-        # The snapshot predates the checkpoint-completion truncation the
-        # live logs already applied; apply it to the restored row too.
-        restored = clog.truncate(restored, from_epoch - 1)
+        restored = restored._replace(
+            epoch_base=jnp.maximum(restored.epoch_base,
+                                   jnp.asarray(from_epoch, jnp.int32)))
         logs = jax.tree_util.tree_map(
             lambda s, r: s.at[flat].set(r), carry.logs, restored)
+        # In-flight ring shard reconstruction: write the replayed outputs
+        # back into the producer's ring at their original step offsets
+        # (reference buildAndLogBuffer — the standby re-cuts identical
+        # buffers and re-logs them so downstream recoveries can be served).
+        rings = list(carry.out_rings)
+        if vid in compiled.ring_index and result.out_steps is not None \
+                and n_steps > 0:
+            ri = compiled.ring_index[vid]
+            el = rings[ri]
+            idx = (jnp.asarray(fence, jnp.int32)
+                   + jnp.arange(n_steps, dtype=jnp.int32)) \
+                & (el.ring_steps - 1)
+            os_ = result.out_steps
+            rings[ri] = el._replace(
+                keys=el.keys.at[idx, sub].set(os_.keys),
+                values=el.values.at[idx, sub].set(os_.values),
+                timestamps=el.timestamps.at[idx, sub].set(os_.timestamps),
+                valid=el.valid.at[idx, sub].set(os_.valid))
         # Record count: checkpoint value + replayed records.
-        rc = ckpt_carry.record_counts[flat] + result.records_replayed
+        rc = snap.record_counts[flat] + result.records_replayed
         return carry._replace(
-            op_states=tuple(ops), logs=logs,
+            op_states=tuple(ops), logs=logs, out_rings=tuple(rings),
             record_counts=carry.record_counts.at[flat].set(rc))

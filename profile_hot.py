@@ -1,0 +1,89 @@
+"""Profile the superstep / scan / checkpoint / replay pieces in isolation."""
+import os, time, json
+import numpy as np
+import jax, jax.numpy as jnp
+
+STEPS = int(os.environ.get("P_STEPS", 64))
+
+from clonos_tpu.api.environment import StreamEnvironment
+from clonos_tpu.runtime.cluster import ClusterRunner
+from clonos_tpu.runtime.executor import DETS_PER_STEP, StepInputs
+
+env = StreamEnvironment(name="prof", num_key_groups=64,
+                        default_edge_capacity=1024)
+(env.synthetic_source(vocab=997, batch_size=128, parallelism=8)
+    .key_by().window_count(num_keys=997, window_size=1 << 30, name="window")
+    .key_by().reduce(num_keys=997, name="reduce").sink())
+job = env.build()
+
+need = 2 * STEPS * DETS_PER_STEP
+cap = 1 << max(need - 1, 1).bit_length()
+runner = ClusterRunner(job, steps_per_epoch=STEPS, log_capacity=cap,
+                       max_epochs=16,
+                       inflight_ring_steps=1 << max(2 * STEPS, 2).bit_length(),
+                       seed=7)
+ex = runner.executor
+
+def t(label, fn, n=1):
+    fn()  # warm
+    t0 = time.monotonic()
+    for _ in range(n):
+        r = fn()
+    jax.block_until_ready(r) if r is not None else None
+    dt = (time.monotonic() - t0) / n
+    print(f"{label}: {dt*1e3:.2f} ms")
+    return dt
+
+# 1. single jitted superstep
+inp = ex._next_inputs()
+def one_step():
+    c, o = ex._jit_step(ex.carry, inp)
+    jax.block_until_ready(c.record_counts)
+    return None
+t("superstep (single call, warm)", one_step, n=10)
+
+# 2. input staging for an epoch
+def stage():
+    ins = [ex._next_inputs() for _ in range(STEPS)]
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *ins)
+    jax.block_until_ready(stacked.time)
+    return None
+t(f"stage {STEPS} StepInputs", stage, n=3)
+
+# 3. scanned epoch
+ins = [ex._next_inputs() for _ in range(STEPS)]
+stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *ins)
+def scan_epoch():
+    c, o = ex._jit_scan(ex.carry, stacked)
+    jax.block_until_ready(c.record_counts)
+    return None
+dt = t(f"scan {STEPS} steps (warm)", scan_epoch, n=3)
+print(f"  -> {dt/STEPS*1e6:.0f} us/step;"
+      f" {STEPS*8*128/dt:.0f} rec/s")
+
+# 4. roll + trunc
+def roll():
+    c = ex._jit_roll(ex.carry, 3)
+    jax.block_until_ready(c.record_counts)
+    return None
+t("epoch roll (catch-up + fences)", roll, n=3)
+
+# 5. checkpoint trigger (device_get + pickle)
+def trig():
+    runner.coordinator.trigger(90, ex.carry, async_write=False)
+    return None
+t("checkpoint trigger (full-carry pickle)", trig, n=1)
+
+# 6. replay scan
+runner.run_epoch(complete_checkpoint=True)
+runner.run_epoch(complete_checkpoint=False)
+runner.run_epoch(complete_checkpoint=False)
+runner.inject_failure([8 + 1])
+rep = runner.recover()
+mgr = rep.managers[0]
+def replay():
+    r = mgr.replayer.replay(mgr.plan)
+    jax.block_until_ready(r.emit_counts)
+    return None
+dt = t(f"replay ({rep.steps_replayed} steps, warm)", replay, n=3)
+print(f"  -> {dt/max(rep.steps_replayed,1)*1e6:.0f} us/replayed-step")

@@ -1,0 +1,108 @@
+"""Microbenchmark TPU primitive costs, all inside lax.scan (real usage shape)."""
+import time
+import jax, jax.numpy as jnp
+import numpy as np
+
+def bench_scan(label, body, carry0, steps=64, n=3):
+    @jax.jit
+    def run(c):
+        return jax.lax.scan(lambda c, _: (body(c), ()), c, None, length=steps)[0]
+    r = jax.block_until_ready(run(carry0))
+    t0 = time.monotonic()
+    for _ in range(n):
+        r = run(r)
+    jax.block_until_ready(r)
+    dt = (time.monotonic() - t0) / n / steps
+    print(f"{label}: {dt*1e6:.1f} us/step")
+    return dt
+
+N = 8192
+T = 8
+CAP = 1024
+K = 997
+key = jax.random.PRNGKey(0)
+tgt0 = jax.random.randint(key, (N,), 0, T, jnp.int32)
+keys1k = jax.random.randint(key, (CAP,), 0, K, jnp.int32)
+
+# perturb carry so XLA can't hoist
+def mix(c):
+    return (c * 1103515245 + 12345) & 0x7FFFFFFF
+
+# A. argsort in scan
+bench_scan("argsort 8192", lambda c: mix(c) + jnp.argsort((tgt0 + c) % T, stable=True)[0],
+           jnp.zeros((), jnp.int32))
+
+# B. cumsum+unique scatter route
+def route_cs(c):
+    tgt = (tgt0 + c) % T
+    oh = (tgt[:, None] == jnp.arange(T)[None, :]).astype(jnp.int32)
+    pos = jnp.cumsum(oh, axis=0)
+    p = pos[jnp.arange(N), tgt] - 1
+    keep = p < CAP
+    row = jnp.where(keep, tgt, T)
+    col = jnp.where(keep, p, 0)
+    out = jnp.zeros((T + 1, CAP), jnp.int32).at[row, col].set(
+        tgt, mode="drop", unique_indices=True)
+    return mix(c) + out[0, 0]
+bench_scan("route cumsum+unique-scatter 8192", route_cs, jnp.zeros((), jnp.int32))
+
+# C. scatter-add 1024->997 vs one-hot matmul
+bench_scan("scatter-add 1024->997",
+           lambda acc: acc.at[keys1k].add(1, mode="drop"),
+           jnp.zeros((K,), jnp.int32), steps=128)
+
+ohc = (keys1k[:, None] == jnp.arange(K)[None, :]).astype(jnp.float32)
+bench_scan("onehot-matvec 1024x997 (precomp oh)",
+           lambda acc: acc + ohc.T @ jnp.ones((CAP,), jnp.float32),
+           jnp.zeros((K,), jnp.float32), steps=128)
+
+def mm_dyn(acc):
+    keys = (keys1k + acc[0].astype(jnp.int32)) % K
+    oh = (keys[:, None] == jnp.arange(K)[None, :]).astype(jnp.float32)
+    return acc + oh.T @ jnp.ones((CAP,), jnp.float32)
+bench_scan("onehot-matvec dynamic oh", mm_dyn, jnp.zeros((K,), jnp.float32), steps=128)
+
+# C2. batched version: [8, 1024] -> [8, 997] (vmap over subtasks)
+keys2 = jax.random.randint(key, (T, CAP), 0, K, jnp.int32)
+def mm_batched(acc):
+    keys = (keys2 + acc[0, 0].astype(jnp.int32)) % K
+    oh = (keys[..., None] == jnp.arange(K)[None, None, :]).astype(jnp.float32)
+    contrib = jnp.einsum("pbk,pb->pk", oh, jnp.ones((T, CAP), jnp.float32),
+                         preferred_element_type=jnp.float32)
+    return acc + contrib
+bench_scan("batched onehot 8x1024x997", mm_batched, jnp.zeros((T, K), jnp.float32), steps=64)
+
+def sc_batched(acc):
+    keys = (keys2 + acc[0, 0]) % K
+    return jax.vmap(lambda a, k: a.at[k].add(1, mode="drop"))(acc, keys)
+bench_scan("batched scatter-add 8x1024->8x997", sc_batched, jnp.zeros((T, K), jnp.int32), steps=64)
+
+# D. small DUS into big ring, in scan (in-flight append analog)
+ring0 = jnp.zeros((512, T, CAP), jnp.int32)
+def dus_ring(ring):
+    i = ring[0, 0, 0] % 512
+    blk = jnp.full((1, T, CAP), ring[0, 0, 1] + 1, jnp.int32)
+    return jax.lax.dynamic_update_slice(ring, blk, (i, 0, 0))
+bench_scan("DUS [1,8,1024] into [512,8,1024]", dus_ring, ring0, steps=128)
+
+# E. det append: [32,4,8] scatter into [32,2048,8] at head (per-step path)
+logs0 = (jnp.zeros((32, 2048, 8), jnp.int32), jnp.zeros((), jnp.int32))
+def det_append(s):
+    rows, head = s
+    blk = jnp.full((32, 4, 8), head, jnp.int32)
+    idx = (head + jnp.arange(4)) & 2047
+    rows = rows.at[:, idx].set(blk)
+    return (rows, head + 4)
+bench_scan("det append [32,4,8] into [32,2048,8]", det_append, logs0, steps=128)
+
+# F. replica direct append: gather [384 owners] + scatter
+own_idx = jnp.asarray(np.random.randint(0, 32, 384), jnp.int32)
+reps0 = (jnp.zeros((384, 2048, 8), jnp.int32), jnp.zeros((), jnp.int32))
+def rep_append(s):
+    rows, head = s
+    blk = jnp.full((32, 4, 8), head, jnp.int32)
+    rblk = blk[own_idx]
+    idx = (head + jnp.arange(4)) & 2047
+    rows = rows.at[:, idx].set(rblk)
+    return (rows, head + 4)
+bench_scan("replica append [384,4,8]", rep_append, reps0, steps=128)

@@ -1,0 +1,61 @@
+"""Isolate: carry-copy vs per-kernel overhead inside lax.scan on this TPU."""
+import time
+import jax, jax.numpy as jnp
+import numpy as np
+
+def bench_scan(label, body, carry0, steps=64, n=3):
+    @jax.jit
+    def run(c):
+        return jax.lax.scan(lambda c, _: (body(c), ()), c, None, length=steps)[0]
+    r = jax.block_until_ready(run(carry0))
+    t0 = time.monotonic()
+    for _ in range(n):
+        r = run(r)
+    jax.block_until_ready(r)
+    dt = (time.monotonic() - t0) / n / steps
+    print(f"{label}: {dt*1e6:.1f} us/step")
+    return dt
+
+# 1. DUS into rings of different sizes, index from side counter
+for S in (128, 512, 2048):
+    ring0 = (jnp.zeros((S, 8, 1024), jnp.int32), jnp.zeros((), jnp.int32))
+    def dus(s, S=S):
+        ring, i = s
+        blk = jnp.full((1, 8, 1024), i, jnp.int32)
+        return (jax.lax.dynamic_update_slice(ring, blk, (i % S, 0, 0)), i + 1)
+    bench_scan(f"DUS [1,8,1024] into [{S},8,1024] ({S*8*4}KB)", dus, ring0)
+
+# 2. tiny scalar-ish body vs N chained small scatters into [1024]
+for k in (1, 2, 4, 8):
+    def many(s, k=k):
+        acc, i = s
+        for j in range(k):
+            acc = acc.at[(i + j) % 1024].add(1)
+        return (acc, i + 1)
+    bench_scan(f"{k} chained 1-elt scatters into [1024]",
+               many, (jnp.zeros((1024,), jnp.int32), jnp.zeros((), jnp.int32)),
+               steps=128)
+
+# 3. k independent elementwise ops on [8,128] arrays
+for k in (1, 4, 16):
+    def body(s, k=k):
+        arrs, i = s
+        arrs = tuple(a * 3 + i for a in arrs)
+        return (arrs, i + 1)
+    arrs0 = tuple(jnp.ones((8, 128), jnp.int32) for _ in range(k))
+    bench_scan(f"{k} elementwise [8,128] muls", body,
+               (arrs0, jnp.zeros((), jnp.int32)), steps=128)
+
+# 4. one big fused matmul per step: [128,128]@[128,128]
+m0 = jnp.eye(128, dtype=jnp.float32)
+def mm(s):
+    m, i = s
+    return (m @ m0 + 1.0, i + 1)
+bench_scan("matmul 128x128", mm, (m0, jnp.zeros((), jnp.int32)), steps=128)
+
+# 5. matmul 1024x1024
+b0 = jnp.ones((1024, 1024), jnp.bfloat16)
+def mm2(s):
+    m, i = s
+    return ((m @ b0 * 0.001).astype(jnp.bfloat16), i + 1)
+bench_scan("matmul 1024x1024 bf16", mm2, (b0, jnp.zeros((), jnp.int32)), steps=128)

@@ -1,0 +1,107 @@
+"""Batched-over-steps kernel costs: routing, segment-sums at block scale."""
+import time
+import jax, jax.numpy as jnp
+import numpy as np
+
+def bench(label, fn, *args, n=3, per=1):
+    r = jax.block_until_ready(fn(*args))
+    t0 = time.monotonic()
+    for _ in range(n):
+        r = fn(*args)
+    jax.block_until_ready(r)
+    dt = (time.monotonic() - t0) / n
+    print(f"{label}: {dt*1e3:.2f} ms  ({dt/per*1e6:.1f} us/step)")
+    return dt
+
+KK = 512           # steps per block
+N = 8192           # records per step (flattened)
+T = 8
+CAP = 1024
+K = 997
+
+key = jax.random.PRNGKey(0)
+tgt = jax.random.randint(key, (KK, N), 0, T, jnp.int32)
+vals = jnp.ones((KK, N), jnp.int32)
+keys_b = jax.random.randint(key, (KK, T, 128), 0, K, jnp.int32)  # [K,P,B]
+
+# A. batched argsort routing
+@jax.jit
+def route_sort(tgt):
+    return jnp.argsort(tgt, axis=1, stable=True)
+bench(f"batched argsort [{KK},{N}]", route_sort, tgt, per=KK)
+
+# B. batched cumsum+unique scatter
+@jax.jit
+def route_cs(tgt, vals):
+    oh = (tgt[..., None] == jnp.arange(T)[None, None, :]).astype(jnp.int32)
+    pos = jnp.cumsum(oh, axis=1)                    # [KK, N, T]
+    p = jnp.take_along_axis(pos, tgt[..., None], axis=2)[..., 0] - 1
+    keep = p < CAP
+    row = jnp.where(keep, tgt, T)
+    col = jnp.where(keep, p, 0)
+    step = jnp.broadcast_to(jnp.arange(KK)[:, None], (KK, N))
+    out = jnp.zeros((KK, T + 1, CAP), jnp.int32).at[
+        step, row, col].set(vals, mode="drop", unique_indices=True)
+    return out
+bench(f"batched cumsum-route [{KK},{N}]", route_cs, tgt, vals, per=KK)
+
+# C. per-(step,subtask) scatter-add contributions [KK,P,B] -> [KK,P,K]
+@jax.jit
+def contribs_scatter(keys_b):
+    z = jnp.zeros((KK, T, K), jnp.int32)
+    step = jnp.broadcast_to(jnp.arange(KK)[:, None, None], keys_b.shape)
+    sub = jnp.broadcast_to(jnp.arange(T)[None, :, None], keys_b.shape)
+    return z.at[step, sub, keys_b].add(1, mode="drop")
+bench(f"per-step contribs scatter [{KK},8,128]->[{KK},8,{K}]",
+      contribs_scatter, keys_b, per=KK)
+
+# D. prefix over steps: cumsum [KK, T, K]
+c = jnp.ones((KK, T, K), jnp.int32)
+@jax.jit
+def prefix(c):
+    return jnp.cumsum(c, axis=0)
+bench(f"cumsum over steps [{KK},8,{K}]", prefix, c, per=KK)
+
+# E. segment boundary: running acc with resets via cummax trick
+fire = (jnp.arange(KK) % 97 == 0)
+@jax.jit
+def seg(c, fire):
+    cum = jnp.cumsum(c, axis=0)
+    step_id = jnp.arange(KK)
+    last_reset = jax.lax.associative_scan(jnp.maximum,
+                                          jnp.where(fire, step_id, -1))
+    base = jnp.where(last_reset[:, None, None] >= 0,
+                     cum[jnp.clip(last_reset, 0, KK - 1)], 0)
+    return cum - base
+bench("segmented cumsum w/ resets", seg, c, fire, per=KK)
+
+# F. bulk det-block build+append for a block: [L,4*KK,8] -> ring [L,32768,8]
+L = 32
+ring = jnp.zeros((L, 32768, 8), jnp.int32)
+blk = jnp.ones((L, 4 * KK, 8), jnp.int32)
+@jax.jit
+def bulk(ring, blk, head):
+    idx = (head + jnp.arange(4 * KK)) & 32767
+    return ring.at[:, idx].set(blk, unique_indices=True)
+bench("bulk log append [32,2048,8]", bulk, ring, blk, jnp.asarray(0, jnp.int32), per=KK)
+
+# G. replica bulk: gather 384 owners + scatter
+own = jnp.asarray(np.random.randint(0, L, 384), jnp.int32)
+rep = jnp.zeros((384, 32768, 8), jnp.int32)
+@jax.jit
+def bulk_rep(rep, blk, head):
+    r = blk[own]
+    idx = (head + jnp.arange(4 * KK)) & 32767
+    return rep.at[:, idx].set(r, unique_indices=True)
+bench("bulk replica append [384,2048,8]", bulk_rep, rep, blk,
+      jnp.asarray(0, jnp.int32), per=KK)
+
+# H. full source generation for a block [KK, P, B]
+@jax.jit
+def gen(seq0):
+    lane = jnp.arange(128)
+    step = jnp.arange(KK)
+    seq = seq0[None, :, None] + step[:, None, None] * 128 + lane[None, None, :]
+    u = seq.astype(jnp.uint32) * jnp.uint32(0x9E3779B9)
+    return (u % jnp.uint32(997)).astype(jnp.int32)
+bench(f"source gen [{KK},8,128]", gen, jnp.zeros((T,), jnp.int32), per=KK)

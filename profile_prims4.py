@@ -1,0 +1,71 @@
+"""Test: (a) lane padding of [..., 8] arrays, (b) int mod cost, (c) layouts."""
+import time
+import jax, jax.numpy as jnp
+import numpy as np
+
+def bench(label, fn, *args, n=5):
+    r = jax.block_until_ready(fn(*args))
+    t0 = time.monotonic()
+    for _ in range(n):
+        r = fn(*args)
+    jax.block_until_ready(r)
+    print(f"{label}: {(time.monotonic()-t0)/n*1e3:.2f} ms")
+
+dev = jax.devices()[0]
+
+def mem():
+    s = dev.memory_stats()
+    return s.get("bytes_in_use", 0) if s else 0
+
+m0 = mem()
+a = jax.block_until_ready(jnp.zeros((384, 32768, 8), jnp.int32))
+m1 = mem()
+print(f"[384,32768,8] int32: logical {384*32768*8*4/1e6:.0f} MB, "
+      f"actual {(m1-m0)/1e6:.0f} MB")
+del a
+b = jax.block_until_ready(jnp.zeros((384, 8, 32768), jnp.int32))
+m2 = mem()
+print(f"[384,8,32768] int32: actual {(m2-m1)/1e6:.0f} MB")
+del b
+c = jax.block_until_ready(jnp.zeros((384, 32768 * 8), jnp.int32))
+m3 = mem()
+print(f"[384,262144] int32: actual {(m3-m2)/1e6:.0f} MB")
+del c
+
+# copy cost by layout
+for shape in [(384, 32768, 8), (384, 8, 32768), (384, 262144)]:
+    x = jnp.zeros(shape, jnp.int32)
+    f = jax.jit(lambda x: x + 1)
+    bench(f"add1 {shape}", f, x)
+
+# scatter along dim1 with trailing 8 vs trailing-major layout
+idx = jnp.arange(2048, dtype=jnp.int32) + 5
+blk_a = jnp.ones((384, 2048, 8), jnp.int32)
+rep_a = jnp.zeros((384, 32768, 8), jnp.int32)
+f_a = jax.jit(lambda r, b: r.at[:, idx].set(b, unique_indices=True))
+bench("scatter [384,2048,8] into [384,32768,8]", f_a, rep_a, blk_a)
+
+blk_b = jnp.ones((384, 8, 2048), jnp.int32)
+rep_b = jnp.zeros((384, 8, 32768), jnp.int32)
+f_b = jax.jit(lambda r, b: r.at[:, :, idx].set(b, unique_indices=True))
+bench("scatter [384,8,2048] into [384,8,32768]", f_b, rep_b, blk_b)
+
+f_c = jax.jit(lambda r, b: jax.lax.dynamic_update_slice(r, b, (0, 5, 0)))
+bench("DUS [384,2048,8] into [384,32768,8]", f_c, rep_a, blk_a)
+f_d = jax.jit(lambda r, b: jax.lax.dynamic_update_slice(
+    r, b, (0, 0, jnp.asarray(5, jnp.int32))))
+bench("DUS [384,8,2048] into [384,8,32768]", f_d, rep_b, blk_b)
+
+# int hash parts on [512,8,128]
+seq = jnp.arange(512 * 8 * 128, dtype=jnp.int32).reshape(512, 8, 128)
+bench("u32 mul-hash only", jax.jit(
+    lambda s: ((s.astype(jnp.uint32) ^ (s.astype(jnp.uint32) >> 16))
+               * jnp.uint32(0x7FEB352D)).astype(jnp.int32)), seq)
+bench("mod 997", jax.jit(
+    lambda s: (s.astype(jnp.uint32) % jnp.uint32(997)).astype(jnp.int32)), seq)
+bench("mod 997 via f64-free trick", jax.jit(
+    lambda s: (s - (s // 997) * 997)), seq)
+# mul-shift modulo alternative (keys uniform enough): take low bits * K >> 32
+bench("mulhi range-map", jax.jit(
+    lambda s: ((s.astype(jnp.uint32).astype(jnp.uint64) * 997) >> 32)
+    .astype(jnp.int32)), seq)

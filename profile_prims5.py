@@ -1,0 +1,99 @@
+"""Reliable chained microbenchmarks: y = fn(y) iterated inside one jit."""
+import time
+import jax, jax.numpy as jnp
+import numpy as np
+from functools import partial
+
+def bench_chain(label, fn, x0, iters=20, per_steps=1, n=3):
+    @jax.jit
+    def run(x):
+        return jax.lax.fori_loop(0, iters, lambda i, x: fn(x, i), x)
+    r = jax.block_until_ready(run(x0))
+    t0 = time.monotonic()
+    for _ in range(n):
+        r = run(r)
+    jax.block_until_ready(r)
+    dt = (time.monotonic() - t0) / n / iters
+    print(f"{label}: {dt*1e3:.3f} ms/iter ({dt/per_steps*1e6:.2f} us/step)")
+    return dt
+
+KK, N, T, CAP, K = 512, 8192, 8, 1024, 997
+
+# A. batched argsort over a block of steps
+def f_sort(x, i):
+    s = jnp.argsort((x + i) % T, axis=1, stable=True).astype(jnp.int32)
+    return (x + s) % 1024
+bench_chain(f"argsort [{KK},{N}] (block of {KK} steps)", f_sort,
+            jnp.ones((KK, N), jnp.int32), per_steps=KK)
+
+# B. batched cumsum route
+def f_route(x, i):
+    tgt = (x + i) % T
+    oh = (tgt[..., None] == jnp.arange(T)[None, None, :]).astype(jnp.int32)
+    pos = jnp.cumsum(oh, axis=1)
+    p = jnp.take_along_axis(pos, tgt[..., None], axis=2)[..., 0] - 1
+    keep = p < CAP
+    row = jnp.where(keep, tgt, T)
+    col = jnp.where(keep, p, 0)
+    step = jnp.broadcast_to(jnp.arange(KK)[:, None], (KK, N))
+    out = jnp.zeros((KK, T + 1, CAP), jnp.int32).at[
+        step, row, col].set(x, mode="drop", unique_indices=True)
+    return x + out[:, :T, :].reshape(KK, N)
+bench_chain(f"cumsum-route [{KK},{N}]", f_route,
+            jnp.ones((KK, N), jnp.int32), iters=10, per_steps=KK)
+
+# C. big hash over [KK,8,128]
+def f_hash(x, i):
+    u = (x + i).astype(jnp.uint32)
+    u = (u ^ (u >> 16)) * jnp.uint32(0x7FEB352D)
+    u = (u ^ (u >> 15)) * jnp.uint32(0x846CA68B)
+    return (u % jnp.uint32(997)).astype(jnp.int32)
+bench_chain(f"hash+mod [{KK},8,128]", f_hash,
+            jnp.ones((KK, 8, 128), jnp.int32), per_steps=KK)
+
+# D. matmuls chained, varying size
+for M in (128, 512, 1024):
+    def f_mm(x, i, M=M):
+        return (x @ x) * 0.999 + 1e-6
+    bench_chain(f"matmul {M}x{M} f32", f_mm,
+                jnp.eye(M, dtype=jnp.float32) * 0.5, iters=50)
+
+# E. per-step contribs scatter for a block
+def f_contrib(x, i):
+    keys = (x + i) % K
+    z = jnp.zeros((KK, T, K), jnp.int32)
+    step = jnp.broadcast_to(jnp.arange(KK)[:, None, None], keys.shape)
+    sub = jnp.broadcast_to(jnp.arange(T)[None, :, None], keys.shape)
+    out = z.at[step, sub, keys].add(1, mode="drop")
+    return x + out[:, :, :128]
+bench_chain(f"contrib scatter [{KK},8,128]->[{KK},8,{K}]", f_contrib,
+            jnp.ones((KK, T, 128), jnp.int32), iters=10, per_steps=KK)
+
+# F. prefix cumsum over steps
+def f_prefix(x, i):
+    return jnp.cumsum(x, axis=0) % 1000 + i
+bench_chain(f"cumsum-over-steps [{KK},8,{K}]", f_prefix,
+            jnp.ones((KK, T, K), jnp.int32), iters=10, per_steps=KK)
+
+# G. bulk log append (big DUS into ring) chained
+L = 32
+def f_bulk(s, i):
+    ring, head = s
+    blk = jnp.full((L, 4 * KK, 8), head, jnp.int32)
+    idx = (head + jnp.arange(4 * KK)) & 32767
+    return (ring.at[:, idx].set(blk, unique_indices=True), head + 4 * KK)
+bench_chain("bulk log append [32,2048,8] into [32,32768,8]", f_bulk,
+            (jnp.zeros((L, 32768, 8), jnp.int32), jnp.asarray(0, jnp.int32)),
+            iters=10, per_steps=KK)
+
+# H. replica bulk append (gather 384 owners + DUS)
+own = jnp.asarray(np.random.randint(0, L, 384), jnp.int32)
+def f_rep(s, i):
+    rep, head = s
+    blk = jnp.full((L, 4 * KK, 8), head, jnp.int32)
+    r = blk[own]
+    idx = (head + jnp.arange(4 * KK)) & 32767
+    return (rep.at[:, idx].set(r, unique_indices=True), head + 4 * KK)
+bench_chain("replica bulk append [384,2048,8]", f_rep,
+            (jnp.zeros((384, 32768, 8), jnp.int32), jnp.asarray(0, jnp.int32)),
+            iters=10, per_steps=KK)

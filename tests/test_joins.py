@@ -91,8 +91,9 @@ def _drive(r):
 
 
 def _assert_carries_equal(a, b):
-    fa = jax.tree_util.tree_leaves(jax.device_get(a))
-    fb = jax.tree_util.tree_leaves(jax.device_get(b))
+    from clonos_tpu.runtime.executor import canonical_carry
+    fa = jax.tree_util.tree_leaves(jax.device_get(canonical_carry(a)))
+    fb = jax.tree_util.tree_leaves(jax.device_get(canonical_carry(b)))
     for xa, xb in zip(fa, fb):
         np.testing.assert_array_equal(np.asarray(xa), np.asarray(xb))
 

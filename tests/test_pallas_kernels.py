@@ -28,27 +28,16 @@ def test_ring_append_matches_scatter_property():
     assert int(jnp.max(heads)) > 0
 
 
-def test_executor_pallas_path_matches_default():
-    from clonos_tpu.api.environment import StreamEnvironment
-    from clonos_tpu.runtime.executor import CompiledJob, StepInputs
-
-    def job():
-        env = StreamEnvironment(num_key_groups=8, default_edge_capacity=32)
-        (env.synthetic_source(vocab=7, batch_size=4, parallelism=2)
-            .key_by().window_count(num_keys=7, window_size=1 << 30).sink())
-        return env.build()
-
-    ca = CompiledJob(job(), log_capacity=1 << 6, max_epochs=8,
-                     inflight_ring_steps=8, use_pallas_append="interpret")
-    cb = CompiledJob(job(), log_capacity=1 << 6, max_epochs=8,
-                     inflight_ring_steps=8, use_pallas_append=False)
-    ins = StepInputs(jnp.asarray(5, jnp.int32), jnp.asarray(9, jnp.int32))
-    carry_a, carry_b = ca.init_carry(), cb.init_carry()
-    step_a, step_b = jax.jit(ca.superstep), jax.jit(cb.superstep)
-    for _ in range(3):
-        carry_a, _ = step_a(carry_a, ins)
-        carry_b, _ = step_b(carry_b, ins)
-    fa = jax.tree_util.tree_leaves(jax.device_get(carry_a))
-    fb = jax.tree_util.tree_leaves(jax.device_get(carry_b))
-    for xa, xb in zip(fa, fb):
-        np.testing.assert_array_equal(np.asarray(xa), np.asarray(xb))
+def test_bulk_append_full_matches_masked_append():
+    """The block executor's bulk path (append_full, unique-index scatter)
+    must agree with the general masked append for full batches."""
+    rng = np.random.RandomState(3)
+    L, cap = 4, 64
+    a = jax.vmap(lambda _: clog.create(cap, 8))(jnp.arange(L))
+    b = jax.vmap(lambda _: clog.create(cap, 8))(jnp.arange(L))
+    for n in (4, 16, 28):   # wraps the ring across rounds
+        rows = jnp.asarray(rng.randint(-9, 9, (L, n, 8)), jnp.int32)
+        a = clog.v_append_full(a, rows)
+        b = clog.v_append(b, rows, jnp.full((L,), n, jnp.int32))
+    np.testing.assert_array_equal(np.asarray(a.rows), np.asarray(b.rows))
+    np.testing.assert_array_equal(np.asarray(a.head), np.asarray(b.head))

@@ -36,8 +36,12 @@ TIMES = list(range(0, 400, 20))  # deterministic causal-time sequence
 
 
 def _carries_equal(a, b):
-    fa, ta = jax.tree_util.tree_flatten(jax.device_get(a))
-    fb, tb = jax.tree_util.tree_flatten(jax.device_get(b))
+    # Compare the canonical (logically-live) state: a recovered subtask
+    # never re-materializes storage a completed checkpoint truncated, so
+    # dead ring slots may hold different garbage than the golden run's.
+    from clonos_tpu.runtime.executor import canonical_carry
+    fa, ta = jax.tree_util.tree_flatten(jax.device_get(canonical_carry(a)))
+    fb, tb = jax.tree_util.tree_flatten(jax.device_get(canonical_carry(b)))
     assert ta == tb
     for xa, xb in zip(fa, fb):
         np.testing.assert_array_equal(np.asarray(xa), np.asarray(xb))

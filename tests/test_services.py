@@ -124,8 +124,9 @@ def test_async_rows_interleave_and_recovery_stays_bit_identical():
         (1, "TimestampDeterminant")]
     assert all(d.timestamp == 777 for _, d in evs)
 
-    a = jax.device_get(r.executor.carry)
-    b = jax.device_get(golden.executor.carry)
+    from clonos_tpu.runtime.executor import canonical_carry
+    a = jax.device_get(canonical_carry(r.executor.carry))
+    b = jax.device_get(canonical_carry(golden.executor.carry))
     fa, _ = jax.tree_util.tree_flatten(a)
     fb, _ = jax.tree_util.tree_flatten(b)
     for xa, xb in zip(fa, fb):
