@@ -224,7 +224,7 @@ class LogReplayer:
             sl = lambda x: x[lo:hi]
             chunk = jax.tree_util.tree_map(sl, inputs)
             state, out = self._jit_block(state, chunk, times[lo:hi],
-                                         rngs[lo:hi], subtask[None])
+                                         rngs[lo:hi], subtask)
             out_chunks.append(out)
             lo = hi
         if out_chunks:

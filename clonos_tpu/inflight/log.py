@@ -112,23 +112,18 @@ def append_block(state: EdgeLogState, block: RecordBatch) -> EdgeLogState:
 
 
 def start_epoch(state: EdgeLogState, epoch_id) -> EdgeLogState:
-    return start_epoch_at(state, epoch_id, state.head)
-
-
-def start_epoch_at(state: EdgeLogState, epoch_id, offset) -> EdgeLogState:
-    """Record epoch ``epoch_id``'s replay-start offset explicitly.
-
-    The executor records ``head - 1`` at the fence: the batch appended at
-    the fence's last step is still *in flight* (depth-1 pipeline — its
-    consumer reads it one step after the fence), so recovering a consumer
-    from this fence needs that one pre-fence batch. Truncation through this
-    marker keeps it alive (the aligned-barrier boundary condition the
-    reference gets from barriers flowing through the pipeline)."""
+    """Record epoch ``epoch_id``'s replay-start offset (= ``head``, the
+    fence). The batch appended at the fence's last step is still *in
+    flight* (depth-1 pipeline — its consumer reads it one step after the
+    fence), but that fence-spanning batch is checkpointed as the edge
+    buffer of the LeanSnapshot, so the ring needs to retain only the
+    post-fence steps (the aligned-barrier boundary condition the reference
+    gets from barriers flowing through the pipeline rides the snapshot
+    instead of the log)."""
     e = jnp.asarray(epoch_id, jnp.int32)
     slot = e % state.max_epochs
     return state._replace(
-        epoch_starts=state.epoch_starts.at[slot].set(
-            jnp.asarray(offset, jnp.int32)),
+        epoch_starts=state.epoch_starts.at[slot].set(state.head),
         latest_epoch=jnp.maximum(state.latest_epoch, e))
 
 

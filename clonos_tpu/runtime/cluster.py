@@ -436,36 +436,43 @@ class ClusterRunner:
         el = patched.out_rings[ri]
         batch, cnt, s0 = ifl.slice_steps(el, start, n)
         got_start = int(s0)
-        if got_start <= start and int(cnt) >= (start - got_start) + n:
+        # Steps physically retained by the ring: slice_steps only clamps to
+        # ``tail``, but when checkpoints stall past ring capacity newer
+        # appends have clobbered positions of steps < head - ring_steps —
+        # those must come from the spill even though tail hasn't advanced.
+        ring_lo = max(int(el.tail), int(el.head) - el.ring_steps)
+        if got_start <= start and start >= ring_lo \
+                and int(cnt) >= (start - got_start) + n:
             return jax.tree_util.tree_map(
                 lambda x: x[start - got_start: start - got_start + n], batch)
-        # Ring shortfall: pull missing leading steps from the spill.
+        # Ring shortfall: pull the missing leading steps from the spill.
         if self.executor.spill_logs is None:
             raise rec.RecoveryError(
                 f"in-flight log of vertex {src_vid} lost steps "
-                f"[{start}, {got_start}) and spill is disabled")
+                f"[{start}, {max(got_start, ring_lo)}) and spill is disabled")
         spill = self.executor.spill_logs[ri]
-        missing = got_start - start
+        boundary = min(start + n, max(got_start, ring_lo))
         parts = []
         have = start
         for ep in spill.retained_epochs():
             ep_start, ep_batch = spill.load_epoch(ep)
             ep_n = ep_batch.keys.shape[0]
             lo = max(have, ep_start)
-            hi = min(start + n, ep_start + ep_n, got_start)
+            hi = min(ep_start + ep_n, boundary)
             if hi > lo:
                 parts.append(jax.tree_util.tree_map(
                     lambda x: x[lo - ep_start: hi - ep_start], ep_batch))
                 have = hi
-            if have >= got_start:
+            if have >= boundary:
                 break
-        if have < min(got_start, start + n):
+        if have < boundary:
             raise rec.RecoveryError(
                 f"vertex {src_vid}: spill does not cover steps "
-                f"[{have}, {got_start})")
-        if int(cnt) > 0 and got_start < start + n:
+                f"[{have}, {boundary})")
+        if boundary < start + n:
             parts.append(jax.tree_util.tree_map(
-                lambda x: x[: start + n - got_start], batch))
+                lambda x: x[boundary - got_start: start + n - got_start],
+                batch))
         out = jax.tree_util.tree_map(
             lambda *xs: jnp.concatenate(xs, axis=0), *parts)
         if out.keys.shape[0] != n:
@@ -630,15 +637,24 @@ class ClusterRunner:
                 and n_steps > 0:
             ri = compiled.ring_index[vid]
             el = rings[ri]
-            idx = (jnp.asarray(fence, jnp.int32)
-                   + jnp.arange(n_steps, dtype=jnp.int32)) \
+            # Only the last ring_steps replayed steps fit in the ring; a
+            # spill-backed replay longer than the ring would otherwise
+            # scatter wrapped duplicate indices (unspecified winner).
+            m = min(n_steps, el.ring_steps)
+            os_ = jax.tree_util.tree_map(
+                lambda x: x[n_steps - m:], result.out_steps)
+            idx = (jnp.asarray(fence + n_steps - m, jnp.int32)
+                   + jnp.arange(m, dtype=jnp.int32)) \
                 & (el.ring_steps - 1)
-            os_ = result.out_steps
             rings[ri] = el._replace(
-                keys=el.keys.at[idx, sub].set(os_.keys),
-                values=el.values.at[idx, sub].set(os_.values),
-                timestamps=el.timestamps.at[idx, sub].set(os_.timestamps),
-                valid=el.valid.at[idx, sub].set(os_.valid))
+                keys=el.keys.at[idx, sub].set(
+                    os_.keys, unique_indices=True),
+                values=el.values.at[idx, sub].set(
+                    os_.values, unique_indices=True),
+                timestamps=el.timestamps.at[idx, sub].set(
+                    os_.timestamps, unique_indices=True),
+                valid=el.valid.at[idx, sub].set(
+                    os_.valid, unique_indices=True))
         # Record count: checkpoint value + replayed records.
         rc = snap.record_counts[flat] + result.records_replayed
         return carry._replace(

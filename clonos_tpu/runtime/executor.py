@@ -507,11 +507,14 @@ class LocalExecutor:
                 replicas = rep.sync_replica_epochs(replicas, e)
             return carry._replace(
                 logs=clog.v_start_epoch(carry.logs, e),
-                # Ring markers sit one step before the fence: the last
-                # appended batch is still in flight (see start_epoch_at).
-                out_rings=tuple(
-                    ifl.start_epoch_at(el, e, jnp.maximum(el.head - 1, 0))
-                    for el in carry.out_rings),
+                # Ring markers sit exactly at the fence. The batch appended
+                # at the fence's last step is still in flight (its consumer
+                # reads it one step after the fence), but that batch rides
+                # the checkpoint as the depth-1 edge buffer of the
+                # LeanSnapshot — the ring copy is redundant, so truncation
+                # may drop it (and recovery never rebuilds it).
+                out_rings=tuple(ifl.start_epoch(el, e)
+                                for el in carry.out_rings),
                 replicas=replicas)
 
         def _trunc(carry: JobCarry, e) -> JobCarry:

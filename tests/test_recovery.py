@@ -151,6 +151,43 @@ def test_concurrent_connected_failures():
     _carries_equal(r.executor.carry, golden.executor.carry)
 
 
+def _bench_job(parallelism=2):
+    """The bench.py topology at test scale: source -> keyed window ->
+    keyed reduce -> sink (4 vertex classes)."""
+    env = StreamEnvironment(name="bench-mini", num_key_groups=16,
+                            default_edge_capacity=32)
+    (env.synthetic_source(vocab=VOCAB, batch_size=4, parallelism=parallelism)
+        .key_by()
+        .window_count(num_keys=VOCAB, window_size=1 << 30, name="window")
+        .key_by()
+        .reduce(num_keys=VOCAB, name="reduce")
+        .sink())
+    return env.build()
+
+
+@pytest.mark.parametrize("flat", [0, 3, 4, 7],
+                         ids=["source", "window", "reduce", "sink"])
+def test_bench_topology_recovery_per_vertex_class(flat):
+    """Every vertex class of the bench topology recovers bit-identically
+    (the round-2 bench only ever failed the window — VERDICT weakness #12)."""
+    def drive(r):
+        r.executor.time_source.now = lambda it=iter(TIMES): next(it)
+        r.run_epoch()
+        r.step()
+        r.step()
+        return r
+
+    golden = drive(ClusterRunner(_bench_job(), steps_per_epoch=3, seed=11))
+    r = drive(ClusterRunner(_bench_job(), steps_per_epoch=3, seed=11))
+    r.inject_failure([flat])
+    report = r.recover()
+    assert report.steps_replayed == 2
+    _carries_equal(r.executor.carry, golden.executor.carry)
+    golden.step()
+    r.step()
+    _carries_equal(r.executor.carry, golden.executor.carry)
+
+
 def test_failure_with_pending_checkpoint_ignores_it():
     r = _runner(TIMES, steps_per_epoch=2)
     r.run_epoch()                      # ckpt 0 completes
