@@ -17,9 +17,9 @@ asserts post-replay log-length equality :121-133) — re-designed TPU-first:
   record-at-a-time replay loop becomes a single compiled program — this is
   where the >=10x replay-rate target lands (BASELINE.md).
 - Determinants arrive as the packed ``int32[n, 8]`` rows the log already
-  stores; because the executor's per-step layout is fixed (TIMESTAMP,
-  ORDER, BUFFER_BUILT — executor.DETS_PER_STEP), the replayer reshapes to
-  ``[steps, 3, lanes]`` and reads payload lanes directly on device.
+  stores; because the executor's per-step layout is fixed (TIMESTAMP, RNG,
+  ORDER, BUFFER_BUILT — executor.DETS_PER_STEP = 4), the replayer locates
+  the ``[steps, 4, lanes]`` sync blocks and reads payload lanes directly.
 - Output reconstruction: the replayed operator re-emits its output batches;
   the replayer verifies each batch's record count against the recorded
   BUFFER_BUILT determinant (the bit-identical buffer-cut check,
@@ -100,6 +100,8 @@ class ReplayResult:
     #: their effects; services replay their values).
     async_events: List[Tuple[int, det.Determinant]] = dataclasses.field(
         default_factory=list)
+    #: wall-clock breakdown of the replay call (parse / device / rebuild).
+    phase_ms: Dict[str, float] = dataclasses.field(default_factory=dict)
 
     def verify(self) -> None:
         """Post-replay equality asserts (reference LogReplayerImpl:127,
@@ -193,10 +195,21 @@ class LogReplayer:
         return ts_idx, int(used), async_events
 
     def replay(self, plan: ReplayPlan) -> ReplayResult:
+        import time as _time
+        phases: Dict[str, float] = {}
+        t_last = _time.monotonic()
+
+        def _clock(name: str) -> None:
+            nonlocal t_last
+            now = _time.monotonic()
+            phases[name] = phases.get(name, 0.0) + (now - t_last) * 1e3
+            t_last = now
+
         n = plan.n_steps
         k = len(self.LAYOUT)
         rows = np.asarray(plan.det_rows)
         ts_idx, used, async_events = self._parse(rows, n)
+        _clock("parse")
         times = jnp.asarray(rows[ts_idx, det.LANE_P + 1], jnp.int32)
         rngs = jnp.asarray(rows[ts_idx + 1, det.LANE_P], jnp.int32)
         expected = jnp.asarray(rows[ts_idx + 3, det.LANE_P], jnp.int32)
@@ -235,6 +248,8 @@ class LogReplayer:
             out_steps = None
             emit_counts = jnp.zeros((0,), jnp.int32)
         final_state = state
+        jax.block_until_ready(emit_counts)
+        _clock("device_replay")
 
         # Regenerate the determinant rows the replayed run would log — the
         # rebuilt log must extend the recovered one bit-for-bit. Sync blocks
@@ -255,11 +270,13 @@ class LogReplayer:
         consumed = (_count_valid(inputs)
                     if plan.input_steps is not None
                     else int(np.asarray(emit_counts).sum()))
+        _clock("rebuild_rows")
         return ReplayResult(
             op_state=final_state, rebuilt_log_rows=jnp.asarray(rebuilt),
             emit_counts=emit_counts, expected_emits=expected,
             out_steps=out_steps,
-            records_replayed=consumed, async_events=async_events)
+            records_replayed=consumed, async_events=async_events,
+            phase_ms=phases)
 
 
 def _rows_from(tag: int, rc: jnp.ndarray, payload: List[jnp.ndarray]

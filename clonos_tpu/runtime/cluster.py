@@ -116,6 +116,9 @@ class RecoveryReport:
     ignored_checkpoints: Tuple[int, ...]
     recovery_ms: float
     managers: Tuple[rec.RecoveryManager, ...]
+    #: wall-clock per recovery phase (fetch_determinants / inputs / replay /
+    #: patch / replica_rebuild) — the cold-recovery cost breakdown.
+    phase_ms: Dict[str, float] = dataclasses.field(default_factory=dict)
 
 
 class OverflowError_(RuntimeError):
@@ -302,8 +305,15 @@ class ClusterRunner:
         managers: List[rec.RecoveryManager] = []
         total_dets = 0
         total_records = 0
+        phases: Dict[str, float] = {}
+
+        def _clock(name: str, since: float) -> float:
+            now = _time.monotonic()
+            phases[name] = phases.get(name, 0.0) + (now - since) * 1e3
+            return now
 
         patched = self.executor.carry
+        tp = _clock("restore", t0)
 
         for flat in failed:
             vid, sub = self._vertex_of(flat)
@@ -355,6 +365,7 @@ class ClusterRunner:
             else:
                 rows, start = mgr.merged_determinants()
             total_dets += len(rows)
+            tp = _clock("fetch_determinants", tp)
 
             # Lost inputs: the checkpointed edge buffer (the depth-1 batch
             # spanning the fence) + the upstream rings' raw outputs,
@@ -375,6 +386,9 @@ class ClusterRunner:
                                                   sub, fence, n_steps)
             elif isinstance(v.operator, HostFeedSource) and n_steps > 0:
                 input_steps = self._reread_feed(vid, sub, snap, rows, n_steps)
+            if input_steps is not None:
+                jax.block_until_ready(input_steps)
+            tp = _clock("inputs", tp)
 
             plan = rec.ReplayPlan(
                 vertex_id=vid, subtask=sub, flat_subtask=flat,
@@ -384,6 +398,7 @@ class ClusterRunner:
                 n_steps=n_steps, verify_outputs=not synthesized)
             result = mgr.run_replay(plan)
             total_records += result.records_replayed
+            tp = _clock("replay", tp)
 
             rebuilt = np.asarray(result.rebuilt_log_rows)
             # The regenerated determinant rows must equal the recovered ones
@@ -396,6 +411,7 @@ class ClusterRunner:
 
             patched = self._patch(patched, snap, vid, sub, flat,
                                   result, rebuilt, from_epoch, fence, n_steps)
+            tp = _clock("patch", tp)
 
         # Replica rows held by revived subtasks: replicas are identical to
         # their owner's log by construction (same bulk appends), so rebuild
@@ -408,6 +424,8 @@ class ClusterRunner:
                     patched.replicas, patched.logs))
 
         self.executor.carry = patched
+        jax.block_until_ready(patched)
+        tp = _clock("replica_rebuild", tp)
         for flat in failed:
             self.heartbeats.revive(flat)
         self.failed.clear()
@@ -418,7 +436,7 @@ class ClusterRunner:
             records_replayed=total_records,
             ignored_checkpoints=ignored,
             recovery_ms=(_time.monotonic() - t0) * 1e3,
-            managers=tuple(managers))
+            managers=tuple(managers), phase_ms=phases)
         self.reports.append(report)
         self._m_recovery_ms.update(report.recovery_ms)
         self._m_recovered_records.inc(report.records_replayed)

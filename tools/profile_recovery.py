@@ -1,0 +1,87 @@
+#!/usr/bin/env python
+"""Recovery-path profiler: phase breakdown of cold and warm recovery at
+(scaled-down) bench topology. Drives the same workload as bench.py and
+prints per-phase wall-clock so optimization targets the real bottleneck.
+
+Env knobs: PROF_STEPS_PER_EPOCH (default 1024), PROF_PAR (default 8),
+PROF_BATCH (default 128), PROF_FAIL (flat subtask, default window s1).
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    from clonos_tpu.runtime.cluster import ClusterRunner
+    from clonos_tpu.runtime.executor import DETS_PER_STEP
+    from clonos_tpu.api.environment import StreamEnvironment
+
+    spe = int(os.environ.get("PROF_STEPS_PER_EPOCH", 1024))
+    par = int(os.environ.get("PROF_PAR", 8))
+    batch = int(os.environ.get("PROF_BATCH", 128))
+    fill = 2
+
+    env = StreamEnvironment(name="prof", num_key_groups=64,
+                            default_edge_capacity=1024)
+    (env.synthetic_source(vocab=997, batch_size=batch, parallelism=par)
+        .key_by()
+        .window_count(num_keys=997, window_size=1 << 30, name="window")
+        .key_by()
+        .reduce(num_keys=997, name="reduce")
+        .sink())
+    job = env.build()
+
+    need = (fill + 1) * spe * DETS_PER_STEP
+    cap = 1 << max(need - 1, 1).bit_length()
+    runner = ClusterRunner(
+        job, steps_per_epoch=spe, log_capacity=cap, max_epochs=16,
+        inflight_ring_steps=1 << max(fill * spe, 2).bit_length(), seed=7)
+
+    t0 = time.monotonic()
+    runner.run_epoch(complete_checkpoint=True)
+    jax.block_until_ready(runner.executor.carry)
+    t_epoch0 = time.monotonic() - t0
+    t0 = time.monotonic()
+    for _ in range(fill):
+        runner.run_epoch(complete_checkpoint=False)
+    jax.block_until_ready(runner.executor.carry)
+    t_fill = time.monotonic() - t0
+
+    failed = int(os.environ.get("PROF_FAIL", par + 1))
+    runner.inject_failure([failed])
+    t0 = time.monotonic()
+    report = runner.recover()
+    jax.block_until_ready(runner.executor.carry)
+    cold_s = time.monotonic() - t0
+
+    mgr = report.managers[0]
+    t0 = time.monotonic()
+    result = mgr.replayer.replay(mgr.plan)
+    jax.block_until_ready(result.emit_counts)
+    warm_s = time.monotonic() - t0
+
+    out = {
+        "steps_per_epoch": spe, "par": par, "batch": batch,
+        "epoch0_s": round(t_epoch0, 2), "fill_s": round(t_fill, 2),
+        "steady_records_per_sec": round(
+            fill * spe * par * batch / t_fill, 0),
+        "cold_recovery_s": round(cold_s, 2),
+        "cold_phases_ms": {k: round(v, 1)
+                           for k, v in report.phase_ms.items()},
+        "warm_replay_s": round(warm_s, 3),
+        "warm_phases_ms": {k: round(v, 1)
+                           for k, v in result.phase_ms.items()},
+        "records_replayed": report.records_replayed,
+        "warm_records_per_sec": round(report.records_replayed / warm_s, 0),
+        "device": str(jax.devices()[0].platform),
+    }
+    print(json.dumps(out, indent=1))
+
+
+if __name__ == "__main__":
+    sys.exit(main())
