@@ -93,6 +93,52 @@ def _scatter_to_targets(
     return zero_invalid(out), dropped
 
 
+def _block_to_targets(
+    batch: RecordBatch, target: jnp.ndarray, num_targets: int,
+    out_capacity: int
+) -> Tuple[RecordBatch, jnp.ndarray]:
+    """Block-form exchange: route a whole ``[K, P, B]`` stack of per-step
+    batches in ONE sort instead of K vmapped sorts.
+
+    Composite sort key = ``step * (T+1) + target`` (invalid records get
+    target T): one stable flat argsort of ``K*P*B`` int32 keys groups
+    records by (step, target) while preserving arrival order within each
+    group — bit-identical to vmapping :func:`_scatter_to_targets` per step.
+    Placement is then a *gather* ``out[k, t, c] = sorted[run_start[k,t]+c]``
+    (run starts via searchsorted), which the TPU executes as fast vector
+    loads — unlike the per-step scatter this replaces, which XLA
+    serializes. ~5x faster at bench shapes (tools/ab_kernels2.py).
+
+    Range guard: needs ``K * (T+1) < 2^31``; checked.
+    """
+    K, P, B = batch.keys.shape
+    T = num_targets
+    n = P * B
+    if K * (T + 1) >= (1 << 31):
+        raise ValueError(f"composite sort key overflow: K={K} T={T}")
+    flat = lambda x: jnp.reshape(x, (K * n,))
+    keys, vals, ts, valid = map(flat, batch)
+    tgt = jnp.where(valid, flat(target), T)
+    step = jnp.repeat(jnp.arange(K, dtype=jnp.int32), n,
+                      total_repeat_length=K * n)
+    composite = step * (T + 1) + tgt
+    order = jnp.argsort(composite, stable=True)
+    sc = composite[order]
+    # Boundary of every (step, target) run: [K*(T+1)] starts.
+    bounds = jnp.arange(K * (T + 1), dtype=jnp.int32)
+    run_start = jnp.searchsorted(sc, bounds, side="left").astype(jnp.int32)
+    run_end = jnp.concatenate(
+        [run_start[1:], jnp.asarray([K * n], jnp.int32)])
+    run_len = (run_end - run_start).reshape(K, T + 1)[:, :T]     # [K, T]
+    dropped = jnp.maximum(run_len - out_capacity, 0).astype(jnp.int32)
+    c = jnp.arange(out_capacity, dtype=jnp.int32)
+    src = run_start.reshape(K, T + 1)[:, :T, None] + c[None, None, :]
+    ok = c[None, None, :] < jnp.minimum(run_len, out_capacity)[:, :, None]
+    pick = order[jnp.clip(src, 0, K * n - 1)]                    # [K, T, cap]
+    out = RecordBatch(keys[pick], vals[pick], ts[pick], ok)
+    return zero_invalid(out), dropped
+
+
 def route_hash(batch: RecordBatch, parallelism: int, num_key_groups: int,
                out_capacity: int) -> Tuple[RecordBatch, jnp.ndarray]:
     """keyBy exchange (KeyGroupStreamPartitioner equivalent)."""
@@ -100,6 +146,59 @@ def route_hash(batch: RecordBatch, parallelism: int, num_key_groups: int,
     return _scatter_to_targets(
         batch, subtask_for_key_group(kg, parallelism, num_key_groups),
         parallelism, out_capacity)
+
+
+def route_hash_block(batch: RecordBatch, parallelism: int,
+                     num_key_groups: int, out_capacity: int
+                     ) -> Tuple[RecordBatch, jnp.ndarray]:
+    """Block form of :func:`route_hash` over ``[K, P, B]`` stacks; returns
+    (routed ``[K, parallelism, out_capacity]``, dropped ``[K, parallelism]``),
+    bit-identical to ``vmap(route_hash)``."""
+    kg = key_group(batch.keys, num_key_groups)
+    return _block_to_targets(
+        batch, subtask_for_key_group(kg, parallelism, num_key_groups),
+        parallelism, out_capacity)
+
+
+def route_rebalance_block(batch: RecordBatch, parallelism: int,
+                          out_capacity: int, offsets: jnp.ndarray
+                          ) -> Tuple[RecordBatch, jnp.ndarray]:
+    """Block form of :func:`route_rebalance`; ``offsets`` is the ``[K]``
+    per-step exclusive round-robin cursor."""
+    K, P, B = batch.keys.shape
+    idx = jnp.arange(P * B, dtype=jnp.int32)[None, :] + offsets[:, None]
+    return _block_to_targets(batch, (idx % parallelism).reshape(K, P, B),
+                             parallelism, out_capacity)
+
+
+def route_broadcast_block(batch: RecordBatch, parallelism: int,
+                          out_capacity: int
+                          ) -> Tuple[RecordBatch, jnp.ndarray]:
+    """Block form of :func:`route_broadcast`."""
+    K = batch.keys.shape[0]
+    one, dropped = _block_to_targets(
+        batch, jnp.zeros(batch.keys.shape, jnp.int32), 1, out_capacity)
+    rep = RecordBatch(*(jnp.broadcast_to(
+        x[:, :1], (K, parallelism) + x.shape[2:]) for x in one))
+    return rep, jnp.broadcast_to(dropped[:, :1], (K, parallelism)
+                                 ).astype(jnp.int32)
+
+
+def route_forward_block(batch: RecordBatch, out_capacity: int
+                        ) -> Tuple[RecordBatch, jnp.ndarray]:
+    """Block form of :func:`route_forward` (no exchange; re-capacity)."""
+    K, P, B = batch.keys.shape
+    if out_capacity == B:
+        return zero_invalid(batch), jnp.zeros((K, P), jnp.int32)
+    if out_capacity > B:
+        pad = ((0, 0), (0, 0), (0, out_capacity - B))
+        return (RecordBatch(*(jnp.pad(x, pad) for x in batch)),
+                jnp.zeros((K, P), jnp.int32))
+    keep = batch.valid[:, :, :out_capacity]
+    dropped = batch.count() - keep.sum(-1).astype(jnp.int32)
+    return zero_invalid(RecordBatch(
+        batch.keys[:, :, :out_capacity], batch.values[:, :, :out_capacity],
+        batch.timestamps[:, :, :out_capacity], keep)), dropped
 
 
 def route_rebalance(batch: RecordBatch, parallelism: int, out_capacity: int,

@@ -524,20 +524,18 @@ class ClusterRunner:
         e = self.job.edges[eidx]
         dst_p = self.job.vertices[e.dst].parallelism
         if e.partition == PartitionType.HASH:
-            r, _ = jax.vmap(lambda b: routing.route_hash(
-                b, dst_p, self.job.num_key_groups, e.capacity))(raw)
+            r, _ = routing.route_hash_block(
+                raw, dst_p, self.job.num_key_groups, e.capacity)
         elif e.partition == PartitionType.FORWARD:
-            r, _ = jax.vmap(lambda b: routing.route_forward(
-                b, e.capacity))(raw)
+            r, _ = routing.route_forward_block(raw, e.capacity)
         elif e.partition == PartitionType.REBALANCE:
             counts = raw.count().sum(axis=1)
             offs = (jnp.asarray(snap.rr_offsets[eidx][0], jnp.int32)
                     + jnp.cumsum(counts) - counts)
-            r, _ = jax.vmap(lambda b, o: routing.route_rebalance(
-                b, dst_p, e.capacity, o))(raw, offs)
+            r, _ = routing.route_rebalance_block(raw, dst_p, e.capacity,
+                                                 offs)
         else:
-            r, _ = jax.vmap(lambda b: routing.route_broadcast(
-                b, dst_p, e.capacity))(raw)
+            r, _ = routing.route_broadcast_block(raw, dst_p, e.capacity)
         return r
 
     def _reread_feed(self, vid: int, sub: int, snap: LeanSnapshot,

@@ -308,23 +308,22 @@ class CompiledJob:
                 e = job.edges[eidx]
                 dst_p = job.vertices[e.dst].parallelism
                 if e.partition == PartitionType.HASH:
-                    r, d = jax.vmap(lambda b: routing.route_hash(
-                        b, dst_p, job.num_key_groups, e.capacity))(out)
+                    r, d = routing.route_hash_block(
+                        out, dst_p, job.num_key_groups, e.capacity)
                 elif e.partition == PartitionType.FORWARD:
-                    r, d = jax.vmap(lambda b: routing.route_forward(
-                        b, e.capacity))(out)
+                    r, d = routing.route_forward_block(out, e.capacity)
                 elif e.partition == PartitionType.REBALANCE:
                     counts = out.count().sum(axis=1)             # [K]
                     offs = (rr_offsets[eidx][0]
                             + jnp.cumsum(counts) - counts)       # exclusive
-                    r, d = jax.vmap(lambda b, o: routing.route_rebalance(
-                        b, dst_p, e.capacity, o))(out, offs)
+                    r, d = routing.route_rebalance_block(
+                        out, dst_p, e.capacity, offs)
                     rr_offsets[eidx] = (
                         (rr_offsets[eidx] + counts.sum())
                         % jnp.asarray(dst_p, jnp.int32))
                 else:
-                    r, d = jax.vmap(lambda b: routing.route_broadcast(
-                        b, dst_p, e.capacity))(out)
+                    r, d = routing.route_broadcast_block(
+                        out, dst_p, e.capacity)
                 routed[eidx] = self._shard_block(r)
                 dropped[eidx] = d
                 new_edge_bufs[eidx] = jax.tree_util.tree_map(

@@ -106,6 +106,56 @@ def test_broadcast_replicates_and_compacts():
         assert vals == [1, 2]
 
 
+def _rand_block(rng, K, P, B, vocab=37, fill=0.7):
+    keys = rng.randint(0, vocab, size=(K, P, B)).astype(np.int32)
+    vals = rng.randint(-1000, 1000, size=(K, P, B)).astype(np.int32)
+    ts = rng.randint(0, 100, size=(K, P, B)).astype(np.int32)
+    valid = rng.rand(K, P, B) < fill
+    return records.zero_invalid(records.RecordBatch(
+        jnp.asarray(keys), jnp.asarray(vals), jnp.asarray(ts),
+        jnp.asarray(valid)))
+
+
+@pytest.mark.parametrize("cap", [4, 16, 64])
+def test_block_routes_bit_identical_to_per_step(cap):
+    """The one-flat-sort block exchange must equal vmapping the per-step
+    exchange, including overflow-drop accounting (the executor switched to
+    the block form for speed; semantics are pinned here)."""
+    import jax
+    rng = np.random.RandomState(3)
+    K, P, B = 7, 3, 16
+    batch = _rand_block(rng, K, P, B)
+    for T, G in [(4, 8), (1, 4), (5, 20)]:
+        r1, d1 = jax.vmap(
+            lambda b: routing.route_hash(b, T, G, cap))(batch)
+        r2, d2 = routing.route_hash_block(batch, T, G, cap)
+        for a, b in zip(jax.tree_util.tree_leaves((r1, d1)),
+                        jax.tree_util.tree_leaves((r2, d2))):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # Rebalance with a running per-step offset.
+    counts = np.asarray(batch.count().sum(axis=1))
+    offs = jnp.asarray(5 + np.cumsum(counts) - counts, jnp.int32)
+    r1, d1 = jax.vmap(lambda b, o: routing.route_rebalance(
+        b, 3, cap, o))(batch, offs)
+    r2, d2 = routing.route_rebalance_block(batch, 3, cap, offs)
+    for a, b in zip(jax.tree_util.tree_leaves((r1, d1)),
+                    jax.tree_util.tree_leaves((r2, d2))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # Broadcast.
+    r1, d1 = jax.vmap(lambda b: routing.route_broadcast(b, 3, cap))(batch)
+    r2, d2 = routing.route_broadcast_block(batch, 3, cap)
+    for a, b in zip(jax.tree_util.tree_leaves((r1, d1)),
+                    jax.tree_util.tree_leaves((r2, d2))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # Forward at smaller/equal/larger capacity.
+    for oc in (B // 2, B, B + 5):
+        r1, d1 = jax.vmap(lambda b: routing.route_forward(b, oc))(batch)
+        r2, d2 = routing.route_forward_block(batch, oc)
+        for a, b in zip(jax.tree_util.tree_leaves((r1, d1)),
+                        jax.tree_util.tree_leaves((r2, d2))):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
 def test_forward_identity():
     batch = _mkbatch([[(1, 5)], [(2, 6)]], cap=3)
     routed, dropped = routing.route_forward(batch, out_capacity=3)

@@ -1,0 +1,157 @@
+#!/usr/bin/env python
+"""Round 2 of kernel A/B: prefix-sum formulations (the block programs'
+dominant cost) and block-flat exchange."""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from clonos_tpu.api.records import RecordBatch, zero_invalid
+from clonos_tpu.parallel import routing
+
+
+def timeit(fn, *args, n=10):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    ts = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        jax.block_until_ready(out)
+        ts.append(time.perf_counter() - t0)
+    return min(ts), out
+
+
+# --- prefix-sum formulations ----------------------------------------------
+
+def cumsum_native(x):
+    return jnp.cumsum(x, axis=0)
+
+
+def cumsum_ascan(x):
+    return jax.lax.associative_scan(jnp.add, x, axis=0)
+
+
+def _tri(n, dtype):
+    i = jnp.arange(n)
+    return (i[:, None] >= i[None, :]).astype(dtype)
+
+
+def cumsum_matmul_f32(x):
+    # Exact while |prefix| < 2^24; x int32 [K, ...]
+    K = x.shape[0]
+    tri = _tri(K, jnp.float32)
+    flat = x.reshape(K, -1).astype(jnp.float32)
+    return jnp.dot(tri, flat, preferred_element_type=jnp.float32
+                   ).astype(jnp.int32).reshape(x.shape)
+
+
+def cumsum_matmul_exact(x):
+    # Exact for full int32: split into 16-bit halves (unsigned lo).
+    K = x.shape[0]
+    tri = _tri(K, jnp.float32)
+    flat = x.reshape(K, -1)
+    lo = (flat & 0xFFFF).astype(jnp.float32)
+    hi = (flat >> 16).astype(jnp.float32)
+    slo = jnp.dot(tri, lo, preferred_element_type=jnp.float32)
+    shi = jnp.dot(tri, hi, preferred_element_type=jnp.float32)
+    # prefixes of 16-bit halves stay < 2^24 for K < 256... not generally.
+    # For exactness across K up to 512: lo sums < 512*65535 < 2^25 — NOT
+    # exactly representable past 2^24. Use two-level: chunk 128.
+    out = (slo.astype(jnp.int64) + (shi.astype(jnp.int64) << 16)
+           ).astype(jnp.int32)
+    return out.reshape(x.shape)
+
+
+def cumsum_chunked(x, chunk=128):
+    # Two-level: in-chunk tri-matmul (f32 exact: chunk*2^16 < 2^24), plus
+    # exclusive carry of chunk totals.
+    K = x.shape[0]
+    assert K % chunk == 0
+    C = K // chunk
+    tri = _tri(chunk, jnp.float32)
+    flat = x.reshape(C, chunk, -1)
+    lo = (flat & 0xFFFF).astype(jnp.float32)
+    hi = (flat >> 16).astype(jnp.float32)
+    slo = jnp.einsum("ij,cjn->cin", tri, lo,
+                     preferred_element_type=jnp.float32).astype(jnp.int64)
+    shi = jnp.einsum("ij,cjn->cin", tri, hi,
+                     preferred_element_type=jnp.float32).astype(jnp.int64)
+    within = (slo + (shi << 16)).astype(jnp.int32)        # [C, chunk, n]
+    totals = within[:, -1]                                 # [C, n]
+    carry = jnp.cumsum(totals, axis=0) - totals            # exclusive [C, n]
+    return (within + carry[:, None]).reshape(x.shape)
+
+
+def main():
+    print("device:", jax.devices()[0].platform)
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randint(0, 1000, size=(512, 8, 997)), jnp.int32)
+
+    ref = None
+    for name, fn in [("native", cumsum_native), ("ascan", cumsum_ascan),
+                     ("matmul_f32", cumsum_matmul_f32),
+                     ("chunked", cumsum_chunked)]:
+        t, out = timeit(jax.jit(fn), x)
+        if ref is None:
+            ref = out
+        eq = bool(jnp.array_equal(ref, out))
+        print(f"cumsum [512,8,997] {name}: {t*1e3:.2f}ms exact={eq}")
+
+    # big-int exactness check for chunked
+    xb = jnp.asarray(rng.randint(-2**28, 2**28, size=(512, 64)), jnp.int32)
+    eq = bool(jnp.array_equal(jnp.cumsum(xb, axis=0),
+                              jax.jit(cumsum_chunked)(xb)))
+    print("chunked exact on +-2^28 values:", eq)
+
+    # [n, T] position cumsum shape (exchange): [512, 7976, 8] along axis 1
+    oh = jnp.asarray(rng.randint(0, 2, size=(512, 7976, 8)), jnp.int32)
+    t, _ = timeit(jax.jit(lambda v: jnp.cumsum(v, axis=1)), oh)
+    print(f"pos-cumsum [512,7976,8] native: {t*1e3:.2f}ms")
+    def chunk_ax1(v):
+        K, n, T = v.shape
+        pad = (-n) % 128
+        vp = jnp.pad(v, ((0, 0), (0, pad), (0, 0)))
+        out = cumsum_chunked(vp.transpose(1, 0, 2).reshape(n + pad, -1))
+        return out.reshape(n + pad, K, T).transpose(1, 0, 2)[:, :n]
+    t, _ = timeit(jax.jit(chunk_ax1), oh)
+    print(f"pos-cumsum [512,7976,8] chunked-matmul: {t*1e3:.2f}ms")
+
+    # --- block-flat sort vs per-step sort ---------------------------------
+    K, P, B = 512, 8, 997
+    n = P * B
+    tgt = jnp.asarray(rng.randint(0, 9, size=(K, n)), jnp.int32)
+    def per_step(tv):
+        return jax.vmap(lambda t: jnp.argsort(t, stable=True))(tv)
+    def flat_sort(tv):
+        key = tv + jnp.arange(K, dtype=jnp.int32)[:, None] * 16
+        return jnp.argsort(key.reshape(-1), stable=True)
+    t1, _ = timeit(jax.jit(per_step), tgt)
+    t2, _ = timeit(jax.jit(flat_sort), tgt)
+    print(f"argsort per-step [512x{n}]: {t1*1e3:.2f}ms   "
+          f"flat [{K*n}]: {t2*1e3:.2f}ms")
+
+    # contrib at smaller capacity
+    for cap in (128, 256, 1024):
+        keys = jnp.asarray(rng.randint(0, 997, size=(K, P, cap)), jnp.int32)
+        vals = jnp.ones((K, P, cap), jnp.int32)
+        valid = jnp.asarray(rng.rand(K, P, cap) < 0.5)
+        def contrib(k, v, m):
+            step = jnp.broadcast_to(
+                jnp.arange(K, dtype=jnp.int32)[:, None, None], k.shape)
+            sub = jnp.broadcast_to(
+                jnp.arange(P, dtype=jnp.int32)[None, :, None], k.shape)
+            return jnp.zeros((K, P, 997), jnp.int32).at[step, sub, k].add(
+                jnp.where(m, v, 0), mode="drop")
+        t, _ = timeit(jax.jit(contrib), keys, vals, valid)
+        print(f"contrib scatter cap={cap}: {t*1e3:.2f}ms")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,65 @@
+#!/usr/bin/env python
+"""bench.py workload with recovery phase_ms breakdown printed (what the
+199s cold recovery is actually spent on)."""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import json
+import numpy as np
+
+
+def main():
+    import jax
+    sys.argv = ["bench"]
+    os.environ.setdefault("BENCH_STEPS_PER_EPOCH", "1024")
+    import bench
+
+    job = bench.build_job()
+    from clonos_tpu.runtime.cluster import ClusterRunner
+    from clonos_tpu.runtime.executor import DETS_PER_STEP
+
+    SPE = int(os.environ["BENCH_STEPS_PER_EPOCH"])
+    need = bench.FILL_EPOCHS * SPE * DETS_PER_STEP
+    cap = 1 << max(need - 1, 1).bit_length()
+    runner = ClusterRunner(job, steps_per_epoch=SPE,
+                          log_capacity=cap, max_epochs=16,
+                          inflight_ring_steps=1 << max(
+                              bench.FILL_EPOCHS * SPE, 2).bit_length(),
+                          seed=7)
+    t0 = time.monotonic()
+    runner.run_epoch(complete_checkpoint=True)
+    print("epoch0 (compile+run):", round(time.monotonic() - t0, 1), "s",
+          flush=True)
+    t0 = time.monotonic()
+    for _ in range(bench.FILL_EPOCHS):
+        runner.run_epoch(complete_checkpoint=False)
+    fill = time.monotonic() - t0
+    print("fill:", round(fill, 1), "s  ->",
+          round(bench.FILL_EPOCHS * SPE * 8 * 128 / fill / 1e3), "k rec/s",
+          flush=True)
+    runner.inject_failure([9])
+    t0 = time.monotonic()
+    report = runner.recover()
+    cold = time.monotonic() - t0
+    print("cold recovery:", round(cold, 1), "s", flush=True)
+    print("cluster phases:", json.dumps(
+        {k: round(v, 1) for k, v in report.phase_ms.items()}), flush=True)
+    print("replay phases:", json.dumps(
+        {k: round(v, 1) for k, v in
+         report.managers[0].result.phase_ms.items()}), flush=True)
+    mgr = report.managers[0]
+    t0 = time.monotonic()
+    res = mgr.replayer.replay(mgr.plan)
+    np.asarray(res.emit_counts)
+    warm = time.monotonic() - t0
+    print("warm replay:", round(warm * 1e3, 1), "ms  phases:",
+          json.dumps({k: round(v, 1) for k, v in res.phase_ms.items()}),
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()
