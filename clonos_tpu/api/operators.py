@@ -28,6 +28,7 @@ from typing import Any, Callable, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from clonos_tpu.api.records import RecordBatch, empty, zero_invalid
 from clonos_tpu.parallel import routing
@@ -72,6 +73,15 @@ class Operator:
     #: output batch capacity per subtask per superstep; None = same as input.
     out_capacity: Optional[int] = None
 
+    #: Replay-padding contract: True iff running extra steps with
+    #: all-invalid input batches and the last step's time/rng repeated
+    #: leaves the operator state unchanged and emits only invalid records.
+    #: Lets the replayer pad a partial tail block to the fixed block size
+    #: (so warm standbys never compile on the failure path). Pure
+    #: generators that advance state unconditionally (SyntheticSource)
+    #: must set this False and accept one tail-shape compile instead.
+    replay_pad_safe: bool = True
+
     def init_state(self, parallelism: int) -> Any:
         return ()
 
@@ -95,6 +105,14 @@ class Operator:
 
         return jax.lax.scan(step, state,
                             (batches, jnp.arange(K, dtype=jnp.int32)))
+
+    def static_out_keys(self) -> Optional[np.ndarray]:
+        """The statically-known key of each output slot, or None when
+        emission keys are dynamic. Dense-table emitters (window) return
+        their key enumeration; the executor then replaces the downstream
+        hash exchange with a compile-time gather plan
+        (routing.StaticRoutePlan) — no sort, no scatter."""
+        return None
 
 
 class TwoInputOperator(Operator):
@@ -177,6 +195,9 @@ class SyntheticSource(Operator):
     vocab: int
     batch_size: int
     rate_limit: Optional[int] = None  # records/superstep cap (None = full)
+
+    #: generates unconditionally per step — padding would advance ``seq``.
+    replay_pad_safe = False
 
     @property
     def out_capacity(self):  # type: ignore[override]
@@ -264,16 +285,12 @@ class KeyedReduceOperator(Operator):
         # over steps must distribute); other reduce_fns take the scan path.
         if self.reduce_fn is not jnp.add:
             return super().process_block(state, batches, bctx)
+        from clonos_tpu.ops.histogram import keyed_hist
         K, p, _ = batches.keys.shape
         nk = self.num_keys
         acc0 = state["acc"]                               # [P, nk]
-        step = jnp.broadcast_to(jnp.arange(K, dtype=jnp.int32)[:, None, None],
-                                batches.keys.shape)
-        sub = jnp.broadcast_to(jnp.arange(p, dtype=jnp.int32)[None, :, None],
-                               batches.keys.shape)
-        contrib = jnp.zeros((K, p, nk), jnp.int32).at[
-            step, sub, batches.keys].add(
-                jnp.where(batches.valid, batches.values, 0), mode="drop")
+        contrib, _ = keyed_hist(batches.keys, batches.values,
+                                batches.valid, nk)        # [K, P, nk]
         cum = jnp.cumsum(contrib, axis=0)                 # inclusive prefix
         acc_end = acc0[None] + cum                        # [K, P, nk]
         out_vals = jnp.where(
@@ -282,6 +299,53 @@ class KeyedReduceOperator(Operator):
                 acc_end.reshape(K * p, nk),
                 batches.keys.reshape(K * p, -1), axis=1
             ).reshape(batches.keys.shape), 0)
+        return ({"acc": acc0 + cum[-1]},
+                zero_invalid(batches._replace(values=out_vals)))
+
+    def process_block_static_keys(self, state, batches, bctx,
+                                  slot_keys: np.ndarray):
+        """Fast path when the input arrives over a StaticRoutePlan edge:
+        ``slot_keys[p, b]`` is the compile-time key of input slot (p, b)
+        (-1 = never mapped). The per-step histogram then needs no dynamic
+        scatter — each key's contributions sit at statically-known slots,
+        so ``contrib`` is a handful of static gathers (one per producer
+        occurrence), and emission is a static gather back. Bit-identical
+        to :meth:`process_block` (integer adds in the same association).
+        """
+        if self.reduce_fn is not jnp.add:
+            return self.process_block(state, batches, bctx)
+        K, p, B = batches.keys.shape
+        nk = self.num_keys
+        sk = np.asarray(slot_keys)
+        if sk.shape != (p, B):
+            raise ValueError(f"slot_keys shape {sk.shape} != {(p, B)}")
+        # Static inverted index: slots carrying key n on subtask q.
+        occ = [[[] for _ in range(nk)] for _ in range(p)]
+        for q in range(p):
+            for b in range(B):
+                k = int(sk[q, b])
+                if 0 <= k < nk:
+                    occ[q][k].append(b)
+        S = max((len(o) for row in occ for o in row), default=0)
+        S = max(S, 1)
+        idx = np.full((p, nk, S), B, np.int32)        # B = zero-pad column
+        for q in range(p):
+            for n in range(nk):
+                for s, b in enumerate(occ[q][n]):
+                    idx[q, n, s] = b
+        vals = jnp.where(batches.valid, batches.values, 0)
+        vpad = jnp.pad(vals, ((0, 0), (0, 0), (0, 1)))    # [K, P, B+1]
+        pp = np.arange(p)[:, None]
+        contrib = vpad[:, pp, idx[:, :, 0]]
+        for s in range(1, S):
+            contrib = contrib + vpad[:, pp, idx[:, :, s]]  # [K, P, nk]
+        cum = jnp.cumsum(contrib, axis=0)
+        acc0 = state["acc"]
+        acc_end = acc0[None] + cum
+        key_of_slot = np.clip(sk, 0, nk - 1)
+        out_vals = jnp.where(
+            batches.valid,
+            acc_end[:, pp, key_of_slot], 0)
         return ({"acc": acc0 + cum[-1]},
                 zero_invalid(batches._replace(values=out_vals)))
 
@@ -351,22 +415,17 @@ class TumblingWindowCountOperator(Operator):
         window_pre = jnp.maximum(w0[None, :], rm_excl[:, None])   # [K, P]
         fire = w_now[:, None] > window_pre                        # [K, P]
 
-        step = jnp.broadcast_to(jnp.arange(K, dtype=jnp.int32)[:, None, None],
-                                batches.keys.shape)
-        sub = jnp.broadcast_to(jnp.arange(p, dtype=jnp.int32)[None, :, None],
-                               batches.keys.shape)
-        contrib = jnp.zeros((K, p, nk), jnp.int32).at[
-            step, sub, batches.keys].add(
-                jnp.where(batches.valid, batches.values, 0), mode="drop")
+        from clonos_tpu.ops.histogram import keyed_hist
+        contrib, _ = keyed_hist(batches.keys, batches.values,
+                                batches.valid, nk)                # [K, P, nk]
         cum = jnp.cumsum(contrib, axis=0)                         # [K, P, nk]
         cum_excl = cum - contrib
 
         kidx = jnp.arange(K, dtype=jnp.int32)[:, None]
         lf = jax.lax.associative_scan(                            # [K, P]
             jnp.maximum, jnp.where(fire, kidx, -1), axis=0)
-        lf_c = jnp.broadcast_to(jnp.clip(lf, 0, K - 1)[:, :, None],
-                                (K, p, nk))
-        seg_base = jnp.take_along_axis(cum_excl, lf_c, axis=0)
+        from clonos_tpu.ops.matops import onehot_gather_rows
+        seg_base = onehot_gather_rows(cum_excl, jnp.clip(lf, 0, K - 1))
         acc_end = jnp.where(lf[:, :, None] >= 0, cum - seg_base,
                             acc0[None] + cum)                     # [K, P, nk]
         emit = jnp.concatenate([acc0[None], acc_end[:-1]], axis=0)
@@ -381,6 +440,10 @@ class TumblingWindowCountOperator(Operator):
             valid=fire[:, :, None] & (emit != 0)))
         return ({"acc": acc_end[-1],
                  "window": jnp.maximum(w0, rm[-1])}, out)
+
+    def static_out_keys(self) -> Optional[np.ndarray]:
+        # Dense table emission: slot i always carries key i.
+        return np.arange(self.num_keys, dtype=np.int32)
 
 
 @dataclasses.dataclass

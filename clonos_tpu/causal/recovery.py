@@ -64,10 +64,12 @@ class ReplayPlan:
     subtask: int                    # subtask index within the vertex
     flat_subtask: int               # global flat id (log row)
     from_epoch: int                 # first lost epoch (checkpoint + 1 ...)
-    #: stacked lost input batches, [n, cap] leaves — one RecordBatch for
-    #: single-input vertices (or re-read feed for HostFeedSource), a
-    #: (left, right) pair for TwoInputOperator vertices, None for
-    #: self-generating sources.
+    #: the lost input batches: a LIST of block_steps-sized chunks (each a
+    #: RecordBatch [CH, cap] for single-input vertices, a (left, right)
+    #: pair for TwoInputOperator vertices), or a legacy stacked [n, cap]
+    #: batch, or None for self-generating sources. Chunked form keeps every
+    #: device program shape-static so the whole replay runs on programs
+    #: compiled at job start (warm standby — no XLA in the failure path).
     input_steps: Optional[Any]
     det_rows: np.ndarray            # int32[m, lanes] merged determinant rows
     det_start: int                  # absolute offset of det_rows[0]
@@ -83,16 +85,18 @@ class ReplayPlan:
 @dataclasses.dataclass
 class ReplayResult:
     op_state: Any                   # rebuilt [1, ...] subtask state slice
-    rebuilt_log_rows: jnp.ndarray   # regenerated determinant rows (sync
+    rebuilt_log_rows: np.ndarray    # regenerated determinant rows (sync
                                     # blocks re-derived, async rows spliced
                                     # back at their recorded positions)
-    emit_counts: jnp.ndarray        # [n] replayed output batch cuts
-    expected_emits: jnp.ndarray     # [n] recorded BUFFER_BUILT values
-    #: the replayed operator's rebuilt output batches [n, out_cap] — the
+    emit_counts: np.ndarray         # [n] replayed output batch cuts (host)
+    expected_emits: np.ndarray      # [n] recorded BUFFER_BUILT values
+    #: the replayed operator's rebuilt output batches as a list of
+    #: block-sized chunks [CH, out_cap] (last chunk may be shorter) — the
     #: reconstruction of the failed producer's in-flight log shard
     #: (reference PipelinedSubpartition.buildAndLogBuffer:536-599: the
-    #: standby re-cuts bit-identical buffers and re-logs them).
-    out_steps: Optional[RecordBatch]
+    #: standby re-cuts bit-identical buffers and re-logs them). Chunked so
+    #: the ring write-back reuses prewarmed fixed-shape programs.
+    out_chunks: Optional[List[RecordBatch]]
     records_replayed: int
     #: async determinants recovered from the log: (step_index, determinant)
     #: fired before superstep ``step_index`` of the replay range (reference
@@ -126,11 +130,26 @@ class LogReplayer:
     >=10x replay-rate target lands, BASELINE.md)."""
 
     def __init__(self, operator: Operator, parallelism: int,
-                 block_steps: int = 512):
+                 block_steps: int = 512, in_slot_keys=None):
         self.operator = operator
         self.parallelism = parallelism
         self.block_steps = block_steps
-        self._jit_block = jax.jit(self._replay_block)
+        #: static [1, cap] input-slot keys when the failed subtask's input
+        #: edge is statically routed (routing.StaticRoutePlan) — replay
+        #: then uses the same fast static-gather aggregation as the live
+        #: block program.
+        self.in_slot_keys = in_slot_keys
+        # Share compiled replay programs across LogReplayer instances for
+        # the same (operator, block shape, slot keys): a later failure of
+        # the same vertex must not pay a retrace (the jit cache is
+        # per-wrapper, and RecoveryManagers are built per failure).
+        cache = operator.__dict__.setdefault("_replay_jit_cache", {})
+        key = (parallelism, block_steps,
+               None if in_slot_keys is None
+               else np.asarray(in_slot_keys).tobytes())
+        if key not in cache:
+            cache[key] = jax.jit(self._replay_block)
+        self._jit_block = cache[key]
 
     def _replay_block(self, op_state, batches, times, rngs, subtask):
         """One block of replay: state has leading dim 1 (the failed subtask
@@ -145,6 +164,10 @@ class LogReplayer:
             left, right = batches
             new_state, out = self.operator.process_block(
                 op_state, (lift(left), lift(right)), bctx)
+        elif self.in_slot_keys is not None and hasattr(
+                self.operator, "process_block_static_keys"):
+            new_state, out = self.operator.process_block_static_keys(
+                op_state, lift(batches), bctx, self.in_slot_keys)
         else:
             new_state, out = self.operator.process_block(
                 op_state, lift(batches), bctx)
@@ -210,84 +233,109 @@ class LogReplayer:
         rows = np.asarray(plan.det_rows)
         ts_idx, used, async_events = self._parse(rows, n)
         _clock("parse")
-        times = jnp.asarray(rows[ts_idx, det.LANE_P + 1], jnp.int32)
-        rngs = jnp.asarray(rows[ts_idx + 1, det.LANE_P], jnp.int32)
-        expected = jnp.asarray(rows[ts_idx + 3, det.LANE_P], jnp.int32)
+        times_np = rows[ts_idx, det.LANE_P + 1].astype(np.int32)
+        rngs_np = rows[ts_idx + 1, det.LANE_P].astype(np.int32)
+        expected = rows[ts_idx + 3, det.LANE_P].astype(np.int32)
 
-        if plan.input_steps is not None:
-            inputs = plan.input_steps
-        else:
+        # Chunked inputs arrive as a plain list (one element per replay
+        # block); legacy stacked inputs are a RecordBatch or a (left,
+        # right) tuple of stacked RecordBatches.
+        chunked = isinstance(plan.input_steps, list)
+        inputs = None if chunked else plan.input_steps
+        if plan.input_steps is None:
             # Source vertex: regenerates its records; inputs are empty.
             cap = self.operator.out_capacity or 1
-            z = jnp.zeros((n, cap), jnp.int32)
-            inputs = RecordBatch(z, z, z, jnp.zeros((n, cap), jnp.bool_))
-
-        def _count_valid(b):
-            if isinstance(b, RecordBatch):
-                return int(np.asarray(b.valid).sum())
-            return sum(_count_valid(x) for x in b)
+            zc = jnp.zeros((self.block_steps, cap), jnp.int32)
+            self._zero_chunk = RecordBatch(
+                zc, zc, zc, jnp.zeros((self.block_steps, cap), jnp.bool_))
 
         state = jax.tree_util.tree_map(
             lambda x: x[plan.subtask][None], plan.checkpoint_op_state)
         subtask = jnp.asarray(plan.subtask, jnp.int32)
-        out_chunks = []
+        out_chunks: List[Any] = []
+        emit_chunks: List[jnp.ndarray] = []
+        consumed_parts: List[jnp.ndarray] = []
+        ch = self.block_steps
         lo = 0
+        ci = 0
         while lo < n:
-            hi = min(lo + self.block_steps, n)
-            sl = lambda x: x[lo:hi]
-            chunk = jax.tree_util.tree_map(sl, inputs)
-            state, out = self._jit_block(state, chunk, times[lo:hi],
-                                         rngs[lo:hi], subtask)
+            hi = min(lo + ch, n)
+            kk = hi - lo
+            # Tail blocks: pad-safe operators run the full fixed block
+            # shape with repeated time/rng and (already all-invalid) pad
+            # inputs, so the warm standby's prewarmed program serves every
+            # n; pad-unsafe operators (pure generators) run the exact tail
+            # and pay one small compile.
+            pad = (kk < ch and self.operator.replay_pad_safe
+                   and (chunked or plan.input_steps is None))
+            if chunked:
+                chunk = plan.input_steps[ci]
+            elif plan.input_steps is None:
+                chunk = self._zero_chunk
+            else:
+                chunk = jax.tree_util.tree_map(lambda x: x[lo:hi], inputs)
+            if kk < ch and not pad and (chunked or
+                                        plan.input_steps is None):
+                chunk = jax.tree_util.tree_map(lambda x: x[:kk], chunk)
+            if plan.input_steps is not None:
+                leaves = [b for b in jax.tree_util.tree_leaves(
+                    chunk, is_leaf=lambda x: isinstance(x, RecordBatch))]
+                consumed_parts.append(
+                    sum(b.count().sum() for b in leaves))
+            if pad:
+                t_in = np.full((ch,), times_np[hi - 1], np.int32)
+                r_in = np.full((ch,), rngs_np[hi - 1], np.int32)
+                t_in[:kk] = times_np[lo:hi]
+                r_in[:kk] = rngs_np[lo:hi]
+            else:
+                t_in = times_np[lo:hi]
+                r_in = rngs_np[lo:hi]
+            state, out = self._jit_block(
+                state, chunk, jnp.asarray(t_in), jnp.asarray(r_in),
+                subtask)
             out_chunks.append(out)
+            emit_chunks.append(out.count())
             lo = hi
-        if out_chunks:
-            out_steps = jax.tree_util.tree_map(
-                lambda *xs: jnp.concatenate(xs, axis=0), *out_chunks)
-            emit_counts = out_steps.count()
+            ci += 1
+        if emit_chunks:
+            emit_counts = jnp.concatenate(emit_chunks, axis=0)
         else:
-            out_steps = None
             emit_counts = jnp.zeros((0,), jnp.int32)
         final_state = state
-        jax.block_until_ready(emit_counts)
+        # Pad steps emit nothing by contract; slice host-side to n.
+        emit_np = np.asarray(emit_counts)[:n]      # d2h sync point
         _clock("device_replay")
 
         # Regenerate the determinant rows the replayed run would log — the
         # rebuilt log must extend the recovered one bit-for-bit. Sync blocks
         # are re-derived from the replay; async rows are spliced back at
         # their recorded positions (append-even-during-replay invariant).
-        t_hi = jnp.where(times < 0, -1, 0)
-        zero = jnp.zeros((n,), jnp.int32)
-        ts_rows = _rows_from(det.TIMESTAMP, zero, [t_hi, times])
-        rng_rows = _rows_from(det.RNG, zero, [rngs])
-        ord_rows = _rows_from(det.ORDER, zero, [zero])
-        bb_rows = _rows_from(det.BUFFER_BUILT, zero, [emit_counts])
-        blocks = np.asarray(jnp.stack([ts_rows, rng_rows, ord_rows, bb_rows],
-                                      axis=1))                  # [n, k, lanes]
+        # Pure numpy: only emit_counts crosses d2h; the old per-lane jnp
+        # construction cost ~300ms of tiny dispatches on the warm path.
+        blocks = np.zeros((n, k, det.NUM_LANES), np.int32)
+        blocks[:, 0, det.LANE_TAG] = det.TIMESTAMP
+        blocks[:, 0, det.LANE_P] = np.where(times_np < 0, -1, 0)
+        blocks[:, 0, det.LANE_P + 1] = times_np
+        blocks[:, 1, det.LANE_TAG] = det.RNG
+        blocks[:, 1, det.LANE_P] = rngs_np
+        blocks[:, 2, det.LANE_TAG] = det.ORDER
+        blocks[:, 3, det.LANE_TAG] = det.BUFFER_BUILT
+        blocks[:, 3, det.LANE_P] = emit_np
         rebuilt = rows[:used].copy()
         sync_pos = (ts_idx[:, None] + np.arange(k)[None, :])    # [n, k]
-        rebuilt[sync_pos.ravel()] = blocks.reshape(n * k, -1)
+        rebuilt[sync_pos.ravel()] = blocks.reshape(n * k, det.NUM_LANES)
 
-        consumed = (_count_valid(inputs)
-                    if plan.input_steps is not None
-                    else int(np.asarray(emit_counts).sum()))
+        consumed = (int(np.asarray(sum(consumed_parts)))
+                    if plan.input_steps is not None and consumed_parts
+                    else 0 if plan.input_steps is not None
+                    else int(emit_np.sum()))
         _clock("rebuild_rows")
         return ReplayResult(
-            op_state=final_state, rebuilt_log_rows=jnp.asarray(rebuilt),
-            emit_counts=emit_counts, expected_emits=expected,
-            out_steps=out_steps,
+            op_state=final_state, rebuilt_log_rows=rebuilt,
+            emit_counts=emit_np, expected_emits=expected,
+            out_chunks=out_chunks if out_chunks else None,
             records_replayed=consumed, async_events=async_events,
             phase_ms=phases)
-
-
-def _rows_from(tag: int, rc: jnp.ndarray, payload: List[jnp.ndarray]
-               ) -> jnp.ndarray:
-    n = rc.shape[0]
-    rows = jnp.zeros((n, det.NUM_LANES), jnp.int32)
-    rows = rows.at[:, det.LANE_TAG].set(tag)
-    rows = rows.at[:, det.LANE_RC].set(rc)
-    for i, p in enumerate(payload):
-        rows = rows.at[:, det.LANE_P + i].set(p)
-    return rows
 
 
 class RecoveryManager:

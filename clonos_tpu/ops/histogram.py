@@ -1,0 +1,135 @@
+"""Keyed histogram (segment-sum) kernel: the TPU replacement for the
+scatter-add at the heart of every keyed aggregation.
+
+The reference aggregates record-at-a-time into hash-keyed state
+(flink-runtime .../state/heap/HeapKeyedStateBackend.java ValueState
+update per record). The dense-table TPU design turns that into a per-step
+histogram ``contrib[row, key] = sum(values where keys == key)`` — but
+XLA's scatter-add serializes its updates on TPU (60-120ms at bench shapes
+for ~4M updates). This Pallas kernel streams the records through the VPU
+as chunked compare-accumulate instead: for each 128-record chunk, a
+``[rows, chunk, key_lanes]`` one-hot compare and an axis reduce — no
+scatter anywhere, ~10x faster (tools/profile_block.py).
+
+On non-TPU backends (the CPU test lane) a bit-identical XLA scatter
+fallback runs instead; ``tests/test_pallas_kernels.py`` pins kernel ==
+fallback in interpret mode.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+#: rows per kernel program (VPU sublane count)
+_ROW_TILE = 8
+#: record columns per in-kernel chunk (VPU lane count)
+_COL_CHUNK = 128
+
+
+def _hist_kernel(keys_ref, vals_ref, sum_ref, cnt_ref):
+    rt, b = keys_ref.shape
+    nkp = sum_ref.shape[1]
+    nchunks = b // _COL_CHUNK
+
+    def body(i, carry):
+        sums, cnts = carry
+        kc = keys_ref[:, pl.ds(i * _COL_CHUNK, _COL_CHUNK)]   # [RT, C]
+        vc = vals_ref[:, pl.ds(i * _COL_CHUNK, _COL_CHUNK)]
+        iota = jax.lax.broadcasted_iota(jnp.int32, (rt, _COL_CHUNK, nkp), 2)
+        oh = kc[:, :, None] == iota
+        sums = sums + jnp.sum(jnp.where(oh, vc[:, :, None], 0), axis=1)
+        cnts = cnts + jnp.sum(oh.astype(jnp.int32), axis=1)
+        return sums, cnts
+
+    sums, cnts = jax.lax.fori_loop(
+        0, nchunks, body,
+        (jnp.zeros((rt, nkp), jnp.int32), jnp.zeros((rt, nkp), jnp.int32)))
+    sum_ref[:] = sums
+    cnt_ref[:] = cnts
+
+
+def _pad_to(x: jnp.ndarray, axis: int, mult: int,
+            fill: int = 0) -> jnp.ndarray:
+    n = x.shape[axis]
+    pad = (-n) % mult
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths, constant_values=fill)
+
+
+@functools.partial(jax.jit, static_argnums=(3, 4))
+def _hist_pallas(keys, vals, valid, nk: int, interpret: bool):
+    r, b = keys.shape
+    nkp = -(-nk // _COL_CHUNK) * _COL_CHUNK
+    # Invalid records AND pad slots get key -1 (matches nothing) — a 0-pad
+    # would count phantom records of key 0.
+    k = _pad_to(jnp.where(valid, keys, -1), 1, _COL_CHUNK, fill=-1)
+    k = _pad_to(k, 0, _ROW_TILE, fill=-1)
+    v = _pad_to(jnp.where(valid, vals, 0), 1, _COL_CHUNK)
+    v = _pad_to(v, 0, _ROW_TILE)
+    rp, bp = k.shape
+    grid = (rp // _ROW_TILE,)
+    spec_in = pl.BlockSpec((_ROW_TILE, bp), lambda i: (i, 0),
+                           memory_space=pltpu.VMEM)
+    spec_out = pl.BlockSpec((_ROW_TILE, nkp), lambda i: (i, 0),
+                            memory_space=pltpu.VMEM)
+    sums, cnts = pl.pallas_call(
+        _hist_kernel,
+        out_shape=(jax.ShapeDtypeStruct((rp, nkp), jnp.int32),
+                   jax.ShapeDtypeStruct((rp, nkp), jnp.int32)),
+        grid=grid,
+        in_specs=[spec_in, spec_in],
+        out_specs=(spec_out, spec_out),
+        interpret=interpret,
+    )(k, v)
+    return sums[:r, :nk], cnts[:r, :nk]
+
+
+def _hist_xla(keys, vals, valid, nk: int):
+    """Scatter-add fallback (bit-identical; used off-TPU)."""
+    r, b = keys.shape
+    row = jnp.broadcast_to(jnp.arange(r, dtype=jnp.int32)[:, None],
+                           keys.shape)
+    sums = jnp.zeros((r, nk), jnp.int32).at[row, keys].add(
+        jnp.where(valid, vals, 0), mode="drop")
+    cnts = jnp.zeros((r, nk), jnp.int32).at[row, keys].add(
+        valid.astype(jnp.int32), mode="drop")
+    return sums, cnts
+
+
+def keyed_hist(keys: jnp.ndarray, vals: jnp.ndarray, valid: jnp.ndarray,
+               nk: int, force: str = ""):
+    """Per-row keyed sums and counts.
+
+    ``keys/vals/valid``: ``[..., B]`` (any leading dims, flattened to rows).
+    Returns ``(sums, counts)`` of shape ``[..., nk]`` — for each row, the
+    sum of ``vals`` and the count of records carrying each key in
+    ``[0, nk)``. Out-of-range keys are dropped (scatter ``mode=drop``
+    parity). ``force``: "pallas" | "interpret" | "xla" | "" (auto: pallas
+    on TPU, xla elsewhere).
+    """
+    lead = keys.shape[:-1]
+    b = keys.shape[-1]
+    r = 1
+    for d in lead:
+        r *= d
+    kf = keys.reshape(r, b)
+    vf = vals.reshape(r, b)
+    mf = valid.reshape(r, b)
+    mode = force or ("pallas" if jax.default_backend() == "tpu" else "xla")
+    if mode == "pallas":
+        sums, cnts = _hist_pallas(kf, vf, mf, nk, False)
+    elif mode == "interpret":
+        sums, cnts = _hist_pallas(kf, vf, mf, nk, True)
+    else:
+        # Out-of-range guard to mirror mode="drop" exactly.
+        ok = mf & (kf >= 0) & (kf < nk)
+        sums, cnts = _hist_xla(jnp.where(ok, kf, 0), vf, ok, nk)
+    return sums.reshape(lead + (nk,)), cnts.reshape(lead + (nk,))

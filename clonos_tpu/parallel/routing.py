@@ -23,9 +23,12 @@ Key-group discipline matches the reference: state is sharded by
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Tuple
 
+import jax
 import jax.numpy as jnp
+import numpy as np
 
 from clonos_tpu.api.records import RecordBatch, zero_invalid
 
@@ -93,6 +96,12 @@ def _scatter_to_targets(
     return zero_invalid(out), dropped
 
 
+#: per-step batches >= this flat size route through one block-wide sort;
+#: smaller ones keep K vmapped sorts (faster: XLA batches small sort
+#: networks across steps — tools/profile_block.py)
+_FLAT_SORT_MIN_N = 4096
+
+
 def _block_to_targets(
     batch: RecordBatch, target: jnp.ndarray, num_targets: int,
     out_capacity: int
@@ -114,29 +123,41 @@ def _block_to_targets(
     K, P, B = batch.keys.shape
     T = num_targets
     n = P * B
-    if K * (T + 1) >= (1 << 31):
-        raise ValueError(f"composite sort key overflow: K={K} T={T}")
-    flat = lambda x: jnp.reshape(x, (K * n,))
-    keys, vals, ts, valid = map(flat, batch)
-    tgt = jnp.where(valid, flat(target), T)
-    step = jnp.repeat(jnp.arange(K, dtype=jnp.int32), n,
-                      total_repeat_length=K * n)
-    composite = step * (T + 1) + tgt
-    order = jnp.argsort(composite, stable=True)
-    sc = composite[order]
-    # Boundary of every (step, target) run: [K*(T+1)] starts.
-    bounds = jnp.arange(K * (T + 1), dtype=jnp.int32)
-    run_start = jnp.searchsorted(sc, bounds, side="left").astype(jnp.int32)
-    run_end = jnp.concatenate(
-        [run_start[1:], jnp.asarray([K * n], jnp.int32)])
-    run_len = (run_end - run_start).reshape(K, T + 1)[:, :T]     # [K, T]
-    dropped = jnp.maximum(run_len - out_capacity, 0).astype(jnp.int32)
-    c = jnp.arange(out_capacity, dtype=jnp.int32)
-    src = run_start.reshape(K, T + 1)[:, :T, None] + c[None, None, :]
-    ok = c[None, None, :] < jnp.minimum(run_len, out_capacity)[:, :, None]
-    pick = order[jnp.clip(src, 0, K * n - 1)]                    # [K, T, cap]
-    out = RecordBatch(keys[pick], vals[pick], ts[pick], ok)
-    return zero_invalid(out), dropped
+    if n >= _FLAT_SORT_MIN_N:
+        # One flat sort over the whole block (amortizes best when each
+        # step's batch is large).
+        if K * (T + 1) >= (1 << 31):
+            raise ValueError(f"composite sort key overflow: K={K} T={T}")
+        flat = lambda x: jnp.reshape(x, (K * n,))
+        keys, vals, ts, valid = map(flat, batch)
+        tgt = jnp.where(valid, flat(target), T)
+        step = jnp.repeat(jnp.arange(K, dtype=jnp.int32), n,
+                          total_repeat_length=K * n)
+        composite = step * (T + 1) + tgt
+        order = jnp.argsort(composite, stable=True)
+        sc = composite[order]
+        # Boundary of every (step, target) run: [K*(T+1)] starts.
+        bounds = jnp.arange(K * (T + 1), dtype=jnp.int32)
+        run_start = jnp.searchsorted(sc, bounds,
+                                     side="left").astype(jnp.int32)
+        run_end = jnp.concatenate(
+            [run_start[1:], jnp.asarray([K * n], jnp.int32)])
+        run_len = (run_end - run_start).reshape(K, T + 1)[:, :T]  # [K, T]
+        dropped = jnp.maximum(run_len - out_capacity, 0).astype(jnp.int32)
+        c = jnp.arange(out_capacity, dtype=jnp.int32)
+        src = run_start.reshape(K, T + 1)[:, :T, None] + c[None, None, :]
+        ok = (c[None, None, :]
+              < jnp.minimum(run_len, out_capacity)[:, :, None])
+        pick = order[jnp.clip(src, 0, K * n - 1)]                # [K, T, cap]
+        out = RecordBatch(keys[pick], vals[pick], ts[pick], ok)
+        return zero_invalid(out), dropped
+    # Small per-step batches: K vmapped sort+scatter exchanges vectorize
+    # better than one long sort run (XLA batches the small sort networks
+    # across the step axis, and dynamic gathers of [T*cap] from small rows
+    # are slower than the scatter here — tools/profile_block.py).
+    return jax.vmap(
+        lambda b, t: _scatter_to_targets(b, t, num_targets, out_capacity)
+    )(batch, target)
 
 
 def route_hash(batch: RecordBatch, parallelism: int, num_key_groups: int,
@@ -199,6 +220,99 @@ def route_forward_block(batch: RecordBatch, out_capacity: int
     return zero_invalid(RecordBatch(
         batch.keys[:, :, :out_capacity], batch.values[:, :, :out_capacity],
         batch.timestamps[:, :, :out_capacity], keep)), dropped
+
+
+def hash32_np(x: np.ndarray) -> np.ndarray:
+    """Host-side (numpy) twin of :func:`hash32` for compile-time planning."""
+    u = np.asarray(x, np.uint64) & 0xFFFFFFFF
+    u = ((u ^ (u >> 16)) * 0x7FEB352D) & 0xFFFFFFFF
+    u = ((u ^ (u >> 15)) * 0x846CA68B) & 0xFFFFFFFF
+    return (u ^ (u >> 16)) & 0xFFFFFFFF
+
+
+@dataclasses.dataclass
+class StaticRoutePlan:
+    """Compile-time hash exchange for producers whose output slots carry
+    *statically known* keys (dense table emitters like the window operator:
+    slot ``i`` always holds key ``i``).
+
+    Because each slot's key — hence key group, hence target subtask — is a
+    compile-time constant, routing needs **no sort and no dynamic
+    placement**: the routed batch is a static gather
+    ``out[k, t, c] = producer_out[k, src_p[t, c], src_slot[t, c]]``. This
+    turns the hottest exchange of keyed pipelines into a few fast vector
+    loads (the dynamic block sort costs hundreds of ms per block at bench
+    shapes; this costs ~nothing).
+
+    Semantics note: slots are *not* compacted — output slot (t, c) is bound
+    to one (producer, slot) pair, and a step's invalid slots stay invalid
+    holes. The per-step multiset of valid records equals the dynamic
+    exchange's; only the slot layout differs. Capacity overflow drops whole
+    *static slots* (deterministically), recorded in ``drop_p/drop_slot``
+    for per-step drop accounting. Arrival order within a target (p-major,
+    slot ascending) matches the dynamic exchange's stable sort.
+    """
+
+    src_p: np.ndarray      # int32 [T, cap]: producer subtask per out slot
+    src_slot: np.ndarray   # int32 [T, cap]: producer slot per out slot
+    ok: np.ndarray         # bool  [T, cap]: out slot is mapped
+    slot_keys: np.ndarray  # int32 [T, cap]: static key (-1 = unmapped)
+    drop_p: np.ndarray     # int32 [D]: overflow slots (producer subtask)
+    drop_slot: np.ndarray  # int32 [D]
+    drop_t: np.ndarray     # int32 [D]: target the overflow belonged to
+
+    def apply(self, out: RecordBatch) -> Tuple[RecordBatch, jnp.ndarray]:
+        """Route a producer block ``[K, P, B]`` -> ``[K, T, cap]``."""
+        K = out.keys.shape[0]
+        T = self.src_p.shape[0]
+        g = lambda x: x[:, self.src_p, self.src_slot]
+        valid = g(out.valid) & self.ok[None]
+        routed = zero_invalid(RecordBatch(
+            g(out.keys), g(out.values), g(out.timestamps), valid))
+        if len(self.drop_p):
+            dv = out.valid[:, self.drop_p, self.drop_slot]  # [K, D]
+            dropped = jnp.zeros((K, T), jnp.int32).at[
+                :, self.drop_t].add(dv.astype(jnp.int32))
+        else:
+            dropped = jnp.zeros((K, T), jnp.int32)
+        return routed, dropped
+
+
+def plan_static_hash(slot_keys: np.ndarray, src_parallelism: int,
+                     parallelism: int, num_key_groups: int,
+                     out_capacity: int) -> StaticRoutePlan:
+    """Build a :class:`StaticRoutePlan` for a HASH edge whose producer
+    emits key ``slot_keys[i]`` in slot ``i`` on every subtask."""
+    slot_keys = np.asarray(slot_keys, np.int64)
+    B = slot_keys.shape[0]
+    kg = (hash32_np(slot_keys) % num_key_groups).astype(np.int64)
+    tgt = (kg * parallelism) // num_key_groups
+    T, cap = parallelism, out_capacity
+    src_p = np.zeros((T, cap), np.int32)
+    src_slot = np.zeros((T, cap), np.int32)
+    ok = np.zeros((T, cap), bool)
+    keys_out = np.full((T, cap), -1, np.int32)
+    drop_p, drop_slot, drop_t = [], [], []
+    for t in range(T):
+        slots = np.nonzero(tgt == t)[0]
+        c = 0
+        for p in range(src_parallelism):      # p-major = arrival order
+            for s in slots:
+                if c < cap:
+                    src_p[t, c] = p
+                    src_slot[t, c] = s
+                    ok[t, c] = True
+                    keys_out[t, c] = slot_keys[s]
+                    c += 1
+                else:
+                    drop_p.append(p)
+                    drop_slot.append(s)
+                    drop_t.append(t)
+    return StaticRoutePlan(
+        src_p=src_p, src_slot=src_slot, ok=ok, slot_keys=keys_out,
+        drop_p=np.asarray(drop_p, np.int32),
+        drop_slot=np.asarray(drop_slot, np.int32),
+        drop_t=np.asarray(drop_t, np.int32))
 
 
 def route_rebalance(batch: RecordBatch, parallelism: int, out_capacity: int,

@@ -135,7 +135,8 @@ class ClusterRunner:
 
     def __init__(self, job: JobGraph, steps_per_epoch: int = 8,
                  num_standby: int = 1, heartbeat_timeout_s: float = 5.0,
-                 checkpoint_dir: Optional[str] = None, **executor_kw):
+                 checkpoint_dir: Optional[str] = None,
+                 prewarm: bool = False, **executor_kw):
         self.job = job
         self.executor = LocalExecutor(job, steps_per_epoch=steps_per_epoch,
                                       **executor_kw)
@@ -172,6 +173,86 @@ class ClusterRunner:
         self._m_recovery_ms = g.histogram("recovery.duration-ms")
         self._m_recovered_records = g.counter("recovery.records-replayed")
         self.watchdog = met.LogOccupancyWatchdog(self.executor, g)
+        #: compiled recovery programs, keyed by (kind, params) — populated
+        #: lazily and by prewarm_recovery() (warm standby: no XLA compile
+        #: in the failure path).
+        self._rjit: Dict[Any, Any] = {}
+        if prewarm:
+            self.prewarm_recovery()
+
+    # --- compiled recovery programs ------------------------------------------
+
+    def _jitted(self, key, make):
+        f = self._rjit.get(key)
+        if f is None:
+            f = jax.jit(make())
+            self._rjit[key] = f
+        return f
+
+    def _chunk(self) -> int:
+        return self.executor.block_steps
+
+    def _fetch_fn(self):
+        cap = self.executor.compiled.log_capacity
+        return self._jitted(("fetch",), lambda: (
+            lambda replicas, r, from_epoch: clog.get_determinants(
+                jax.tree_util.tree_map(lambda x: x[r], replicas),
+                from_epoch, cap)))
+
+    def _ring_chunk_fn(self, ri: int, m: int):
+        return self._jitted(("ring_chunk", ri, m), lambda: (
+            lambda el, start: ifl.slice_steps(el, start, m)))
+
+    def _route_chunk_fn(self, eidx: int, m: int):
+        """Route an [m, P_src, B] raw chunk over edge ``eidx`` and select
+        one destination subtask's lane: returns ([m, cap], total_count).
+
+        ``need`` masks steps >= need to invalid: a fixed-size chunk window
+        can extend past the replay range into steps the failed subtask
+        never consumed — those must replay as empty inputs (the
+        replay-padding contract), not as the next epoch's records."""
+        e = self.job.edges[eidx]
+        dst_p = self.job.vertices[e.dst].parallelism
+        compiled = self.executor.compiled
+
+        def make():
+            def f(raw, sub, rr0, need):
+                live = jnp.arange(m, dtype=jnp.int32) < need
+                raw = raw._replace(
+                    valid=raw.valid & live[:, None, None])
+                if eidx in compiled.static_route:
+                    r, _ = compiled.static_route[eidx].apply(raw)
+                elif e.partition == PartitionType.HASH:
+                    r, _ = routing.route_hash_block(
+                        raw, dst_p, self.job.num_key_groups, e.capacity)
+                elif e.partition == PartitionType.FORWARD:
+                    r, _ = routing.route_forward_block(raw, e.capacity)
+                elif e.partition == PartitionType.REBALANCE:
+                    counts = raw.count().sum(axis=1)
+                    offs = rr0 + jnp.cumsum(counts) - counts
+                    r, _ = routing.route_rebalance_block(
+                        raw, dst_p, e.capacity, offs)
+                else:
+                    r, _ = routing.route_broadcast_block(
+                        raw, dst_p, e.capacity)
+                routed_sub = jax.tree_util.tree_map(lambda x: x[:, sub], r)
+                return routed_sub, raw.count().sum()
+            return f
+        return self._jitted(("route_chunk", eidx, m), make)
+
+    def _replica_copy_fn(self):
+        return self._jitted(("replica_copy",), lambda: (
+            lambda replicas, logs, ri, oi: jax.tree_util.tree_map(
+                lambda s, l: s.at[ri].set(l[oi], mode="drop"),
+                replicas, logs)))
+
+    def _first_chunk_fn(self, eidx: int):
+        """Prepend the checkpointed depth-1 edge buffer to the first
+        routed chunk (replay step 0 consumes it)."""
+        return self._jitted(("first_chunk", eidx), lambda: (
+            lambda buf_sub, routed: jax.tree_util.tree_map(
+                lambda a, b: jnp.concatenate([a, b], axis=0),
+                buf_sub, routed)))
 
     # --- steady state --------------------------------------------------------
 
@@ -318,10 +399,8 @@ class ClusterRunner:
         for flat in failed:
             vid, sub = self._vertex_of(flat)
             v = self.job.vertices[vid]
-            mgr = rec.RecoveryManager(
-                vid, sub, flat,
-                rec.LogReplayer(v.operator, v.parallelism,
-                                block_steps=self.executor.block_steps))
+            mgr = rec.RecoveryManager(vid, sub, flat,
+                                      self._make_replayer(vid, sub))
             managers.append(mgr)
             in_edges = self.job.in_edges(vid)
             out_edges = self.job.out_edges(vid)
@@ -353,10 +432,11 @@ class ClusterRunner:
                 # sinks, TwoPhaseCommitSinkFunction.)
                 synthesized = True
             mgr.expect_determinant_responses(len(holders))
+            fetch = self._fetch_fn()
             for r, _h in holders:
-                one = jax.tree_util.tree_map(lambda x: x[r], patched.replicas)
-                buf, count, start = clog.get_determinants(
-                    one, from_epoch, max_out=self._det_request_max())
+                buf, count, start = fetch(
+                    patched.replicas, jnp.asarray(r, jnp.int32),
+                    jnp.asarray(from_epoch, jnp.int32))
                 mgr.notify_determinant_response(
                     np.asarray(buf)[: int(count)], int(start))
             if synthesized:
@@ -376,11 +456,11 @@ class ClusterRunner:
                                                   TwoInputOperator)
             input_steps = None
             if isinstance(v.operator, TwoInputOperator):
-                input_steps = (
+                input_steps = list(zip(
                     self._replay_inputs(patched, snap, in_edges[0], sub,
                                         fence, n_steps),
                     self._replay_inputs(patched, snap, in_edges[1], sub,
-                                        fence, n_steps))
+                                        fence, n_steps)))
             elif in_edges:
                 input_steps = self._replay_inputs(patched, snap, in_edges[0],
                                                   sub, fence, n_steps)
@@ -415,13 +495,24 @@ class ClusterRunner:
 
         # Replica rows held by revived subtasks: replicas are identical to
         # their owner's log by construction (same bulk appends), so rebuild
-        # by copying the owner's (possibly just-restored) log row.
+        # by copying the owner's (possibly just-restored) log row — one
+        # batched scatter for the whole failure set.
+        rs, os_ = [], []
         for flat in failed:
             for r in self.plan.replicas_held_by(flat):
-                o = self.plan.pairs[r][0]
-                patched = patched._replace(replicas=jax.tree_util.tree_map(
-                    lambda s, l: s.at[r].set(l[o]),
-                    patched.replicas, patched.logs))
+                rs.append(r)
+                os_.append(self.plan.pairs[r][0])
+        if rs:
+            # Fixed-size scatter (pad with out-of-range rows, mode=drop)
+            # so one prewarmed program serves every failure-set size.
+            nr = self.plan.num_replicas
+            rs_p = np.full((nr,), nr, np.int32)
+            os_p = np.zeros((nr,), np.int32)
+            rs_p[:len(rs)] = rs
+            os_p[:len(os_)] = os_
+            patched = patched._replace(replicas=self._replica_copy_fn()(
+                patched.replicas, patched.logs,
+                jnp.asarray(rs_p), jnp.asarray(os_p)))
 
         self.executor.carry = patched
         jax.block_until_ready(patched)
@@ -442,27 +533,135 @@ class ClusterRunner:
         self._m_recovered_records.inc(report.records_replayed)
         return report
 
+    def prewarm_recovery(self, vertex_ids: Optional[Sequence[int]] = None
+                         ) -> float:
+        """Compile every recovery program a standby will need, at job
+        start — the reference keeps standby tasks *deployed* so failover
+        only switches them to RUNNING (Task.java:300-302, :1040,
+        Execution.java:373-377 state re-dispatch); the TPU analog of
+        "deployed" is "XLA-compiled": after this, the failure path runs
+        entirely on cached executables (recovery-time-to-resume drops from
+        minutes of compile to milliseconds of replay).
+
+        Requires ``num_standby >= 1`` (the knob that buys warm failover).
+        Returns wall-clock seconds spent compiling. For vertices whose
+        input edge is statically routed the replay program is specialized
+        per subtask; all subtasks are prewarmed.
+        """
+        if self.standbys.num_standby_per_vertex < 1:
+            raise rec.RecoveryError(
+                "prewarm_recovery needs num_standby >= 1 (no standby "
+                "programs requested)")
+        t0 = _time.monotonic()
+        from clonos_tpu.api.operators import TwoInputOperator
+        from clonos_tpu.api.records import RecordBatch as RB
+        ch = self._chunk()
+        carry = self.executor.carry
+        compiled = self.executor.compiled
+        zero = lambda shape, dt=jnp.int32: jnp.zeros(shape, dt)
+
+        def zero_batch(lead):
+            return RB(zero(lead), zero(lead), zero(lead),
+                      zero(lead, jnp.bool_))
+
+        # Fetch + replica copy.
+        if compiled.plan.num_replicas > 0:
+            self._fetch_fn()(carry.replicas, jnp.asarray(0, jnp.int32),
+                             jnp.asarray(0, jnp.int32))
+            nr = compiled.plan.num_replicas
+            self._replica_copy_fn()(
+                carry.replicas, carry.logs,
+                jnp.full((nr,), nr, jnp.int32), zero((nr,)))
+        # Shared log-restore programs.
+        st = clog.create(compiled.log_capacity, compiled.max_epochs)
+        st = self._log_restore_fn()(
+            zero((ch * DETS_PER_STEP, det.NUM_LANES)),
+            jnp.asarray(0, jnp.int32), st)
+        self._log_finalize_fn()(
+            st, zero((compiled.max_epochs,)),
+            zero((compiled.max_epochs,), jnp.bool_),
+            jnp.asarray(0, jnp.int32), jnp.asarray(0, jnp.int32))
+
+        vids = (list(vertex_ids) if vertex_ids is not None
+                else [v.vertex_id for v in self.job.vertices])
+        for vid in vids:
+            v = self.job.vertices[vid]
+            in_edges = self.job.in_edges(vid)
+            # Ring/route/concat programs for each input edge.
+            for eidx in in_edges:
+                e = self.job.edges[eidx]
+                src_p = self.job.vertices[e.src].parallelism
+                src_cap = compiled.vertex_out_capacity(e.src)
+                ri = compiled.ring_index[e.src]
+                el = carry.out_rings[ri]
+                for m in (ch - 1, ch):
+                    if m <= 0:
+                        continue
+                    self._ring_chunk_fn(ri, m)(el, jnp.asarray(0, jnp.int32))
+                    self._route_chunk_fn(eidx, m)(
+                        zero_batch((m, src_p, src_cap)),
+                        jnp.asarray(0, jnp.int32), jnp.asarray(0, jnp.int32),
+                        jnp.asarray(0, jnp.int32))
+                self._first_chunk_fn(eidx)(
+                    zero_batch((1, e.capacity)),
+                    zero_batch((ch - 1, e.capacity)))
+            # Replay block program(s).
+            slot_keys = compiled.consumer_slot_keys(vid)
+            subs = range(v.parallelism) if slot_keys is not None else [0]
+            in_cap = (self.job.edges[in_edges[0]].capacity if in_edges
+                      else compiled.vertex_out_capacity(vid))
+            state0 = jax.tree_util.tree_map(
+                lambda x: x[0][None], carry.op_states[vid])
+            if isinstance(v.operator, TwoInputOperator):
+                cap2 = self.job.edges[in_edges[1]].capacity
+                chunk0 = (zero_batch((ch, in_cap)), zero_batch((ch, cap2)))
+            else:
+                chunk0 = zero_batch((ch, in_cap))
+            for sub in subs:
+                rp = self._make_replayer(vid, sub)
+                rp._jit_block(state0, chunk0, zero((ch,)), zero((ch,)),
+                              jnp.asarray(sub, jnp.int32))
+            # Graft + ring write.
+            self._graft_fn(vid)(
+                carry, state0, st, jnp.asarray(0, jnp.int32),
+                jnp.asarray(0, jnp.int32), jnp.asarray(0, jnp.int32))
+            if vid in compiled.ring_index:
+                ri = compiled.ring_index[vid]
+                out_cap = compiled.vertex_out_capacity(vid)
+                self._ring_write_fn(ri, ch)(
+                    carry.out_rings[ri], zero_batch((ch, out_cap)),
+                    jnp.asarray(0, jnp.int32), jnp.asarray(0, jnp.int32),
+                    jnp.asarray(1, jnp.int32), jnp.asarray(0, jnp.int32))
+        return _time.monotonic() - t0
+
     # --- input reconstruction ------------------------------------------------
 
     def _ring_steps(self, patched: JobCarry, src_vid: int, start: int,
-                    n: int):
+                    n: int, need: Optional[int] = None):
         """Raw output steps [start, start+n) of a producer vertex, from the
         device ring — falling back to the host spill for steps the ring no
-        longer retains (reference SpilledReplayIterator.java:61)."""
+        longer retains (reference SpilledReplayIterator.java:61).
+
+        ``need``: how many leading steps must actually be present
+        (default n). With need < n the returned [n]-shaped batch may hold
+        dead entries past ``need`` — chunked replay reads fixed-size
+        [CH] windows whose tail can extend past the ring head."""
+        if need is None:
+            need = n
         compiled = self.executor.compiled
         ri = compiled.ring_index[src_vid]
         el = patched.out_rings[ri]
-        batch, cnt, s0 = ifl.slice_steps(el, start, n)
+        batch, cnt, s0 = self._ring_chunk_fn(ri, n)(
+            el, jnp.asarray(start, jnp.int32))
         got_start = int(s0)
         # Steps physically retained by the ring: slice_steps only clamps to
         # ``tail``, but when checkpoints stall past ring capacity newer
         # appends have clobbered positions of steps < head - ring_steps —
         # those must come from the spill even though tail hasn't advanced.
         ring_lo = max(int(el.tail), int(el.head) - el.ring_steps)
-        if got_start <= start and start >= ring_lo \
-                and int(cnt) >= (start - got_start) + n:
-            return jax.tree_util.tree_map(
-                lambda x: x[start - got_start: start - got_start + n], batch)
+        if got_start == start and start >= ring_lo \
+                and int(cnt) >= need:
+            return batch
         # Ring shortfall: pull the missing leading steps from the spill.
         if self.executor.spill_logs is None:
             raise rec.RecoveryError(
@@ -470,6 +669,7 @@ class ClusterRunner:
                 f"[{start}, {max(got_start, ring_lo)}) and spill is disabled")
         spill = self.executor.spill_logs[ri]
         boundary = min(start + n, max(got_start, ring_lo))
+        required_end = min(start + need, boundary)
         parts = []
         have = start
         for ep in spill.retained_epochs():
@@ -483,10 +683,16 @@ class ClusterRunner:
                 have = hi
             if have >= boundary:
                 break
-        if have < boundary:
+        if have < required_end:
             raise rec.RecoveryError(
                 f"vertex {src_vid}: spill does not cover steps "
-                f"[{have}, {boundary})")
+                f"[{have}, {required_end})")
+        if have < boundary:
+            # Dead filler past the needed range (fixed-shape chunk reads).
+            ref = parts[0] if parts else batch
+            parts.append(jax.tree_util.tree_map(
+                lambda x: jnp.zeros((boundary - have,) + x.shape[1:],
+                                    x.dtype), ref))
         if boundary < start + n:
             parts.append(jax.tree_util.tree_map(
                 lambda x: x[boundary - got_start: start + n - got_start],
@@ -504,45 +710,56 @@ class ClusterRunner:
         """The failed consumer's lost inputs on edge ``eidx``: the
         checkpointed depth-1 edge buffer (its input at the first lost step)
         followed by the upstream's ring outputs [fence, fence+n-1), routed
-        through the deterministic exchange."""
+        through the deterministic exchange.
+
+        Returns a LIST of block-sized chunks ([CH, cap] each; the last
+        covers the tail) so every device program here is fixed-shape and
+        prewarm-compiled — recovery pays no XLA compile (warm standby)."""
         e = self.job.edges[eidx]
+        ch = self._chunk()
         first = jax.tree_util.tree_map(
             lambda x: x[sub][None], snap.edge_bufs[eidx])
-        if n_steps <= 1:
-            return first if n_steps == 1 else jax.tree_util.tree_map(
-                lambda x: x[:0], first)
-        raw = self._ring_steps(patched, e.src, fence, n_steps - 1)
-        routed = self._route_block(eidx, raw, snap)
-        routed_sub = jax.tree_util.tree_map(lambda x: x[:, sub], routed)
-        return jax.tree_util.tree_map(
-            lambda a, b: jnp.concatenate([a, b], axis=0), first, routed_sub)
-
-    def _route_block(self, eidx: int, raw, snap: LeanSnapshot):
-        """Re-run the exchange for a block of raw producer outputs — the
-        replay-side of 'exchanges are deterministic, so the network needs
-        no determinants' (parallel/routing.py)."""
-        e = self.job.edges[eidx]
-        dst_p = self.job.vertices[e.dst].parallelism
-        if e.partition == PartitionType.HASH:
-            r, _ = routing.route_hash_block(
-                raw, dst_p, self.job.num_key_groups, e.capacity)
-        elif e.partition == PartitionType.FORWARD:
-            r, _ = routing.route_forward_block(raw, e.capacity)
-        elif e.partition == PartitionType.REBALANCE:
-            counts = raw.count().sum(axis=1)
-            offs = (jnp.asarray(snap.rr_offsets[eidx][0], jnp.int32)
-                    + jnp.cumsum(counts) - counts)
-            r, _ = routing.route_rebalance_block(raw, dst_p, e.capacity,
-                                                 offs)
-        else:
-            r, _ = routing.route_broadcast_block(raw, dst_p, e.capacity)
-        return r
+        if n_steps <= 0:
+            return []
+        sub_j = jnp.asarray(sub, jnp.int32)
+        rr0 = jnp.asarray(snap.rr_offsets[eidx][0], jnp.int32)
+        chunks = []
+        nblocks = -(-n_steps // ch)
+        for i in range(nblocks):
+            hi = min(n_steps, (i + 1) * ch)
+            if i == 0:
+                # Replay block 0 consumes [edge_buf, routed(fence ..
+                # fence+ch-1)].
+                m = ch - 1
+                need = min(n_steps - 1, m)
+                if m > 0:
+                    raw = self._ring_steps(patched, e.src, fence, m,
+                                           need=need)
+                    routed, cnt = self._route_chunk_fn(eidx, m)(
+                        raw, sub_j, rr0, jnp.asarray(need, jnp.int32))
+                    rr0 = rr0 + cnt
+                    chunk = self._first_chunk_fn(eidx)(first, routed)
+                else:
+                    chunk = first
+            else:
+                need = hi - i * ch
+                raw = self._ring_steps(patched, e.src,
+                                       fence + i * ch - 1, ch,
+                                       need=need)
+                routed, cnt = self._route_chunk_fn(eidx, ch)(
+                    raw, sub_j, rr0, jnp.asarray(need, jnp.int32))
+                rr0 = rr0 + cnt
+                chunk = routed
+            chunks.append(chunk)
+        return chunks
 
     def _reread_feed(self, vid: int, sub: int, snap: LeanSnapshot,
                      rows: np.ndarray, n_steps: int):
         """Rebuild a HostFeedSource's lost input batches: offset from the
         checkpointed operator state, per-step pull counts from the recorded
-        BUFFER_BUILT determinants, records from the rewindable reader."""
+        BUFFER_BUILT determinants, records from the rewindable reader.
+        Returns block-sized chunks (zero-padded tail) like
+        :meth:`_replay_inputs`."""
         reader = self.executor.feed_readers.get(vid)
         if reader is None:
             raise rec.RecoveryError(
@@ -554,17 +771,23 @@ class ClusterRunner:
                            & (rows[:, det.LANE_RC] == 0))[0][:n_steps]
         counts = rows[anchors + 3, det.LANE_P].astype(np.int64)
         offset = int(np.asarray(snap.op_states[vid]["offset"][sub]))
-        keys = np.zeros((n_steps, b), np.int32)
-        vals = np.zeros((n_steps, b), np.int32)
-        valid = np.zeros((n_steps, b), bool)
+        ch = self._chunk()
+        padded = -(-n_steps // ch) * ch
+        keys = np.zeros((padded, b), np.int32)
+        vals = np.zeros((padded, b), np.int32)
+        valid = np.zeros((padded, b), bool)
         for i, c in enumerate(counts):
             ks, vs = reader.read_at(sub, offset, int(c))
             keys[i, :int(c)], vals[i, :int(c)] = ks, vs
             valid[i, :int(c)] = True
             offset += int(c)
         from clonos_tpu.api.records import RecordBatch as RB
-        return RB(jnp.asarray(keys), jnp.asarray(vals),
-                  jnp.zeros((n_steps, b), jnp.int32), jnp.asarray(valid))
+        zts = np.zeros((padded, b), np.int32)
+        return [RB(jnp.asarray(keys[lo:lo + ch]),
+                   jnp.asarray(vals[lo:lo + ch]),
+                   jnp.asarray(zts[lo:lo + ch]),
+                   jnp.asarray(valid[lo:lo + ch]))
+                for lo in range(0, padded, ch)]
 
     def _synthesize_det_rows(self, fence_global: int,
                              n_steps: int) -> np.ndarray:
@@ -589,36 +812,109 @@ class ClusterRunner:
             rows[base + 3, det.LANE_TAG] = det.BUFFER_BUILT
         return rows
 
-    def _det_request_max(self) -> int:
-        # A replica can never serve more rows than its ring retains.
-        return self.executor.compiled.log_capacity
+    def _make_replayer(self, vid: int, sub: int) -> rec.LogReplayer:
+        """Standby replay program for (vertex, subtask); compiled programs
+        are cached on the operator so repeated failures (and prewarm)
+        share them."""
+        v = self.job.vertices[vid]
+        slot_keys = self.executor.compiled.consumer_slot_keys(vid)
+        return rec.LogReplayer(
+            v.operator, v.parallelism,
+            block_steps=self.executor.block_steps,
+            in_slot_keys=(slot_keys[sub:sub + 1]
+                          if slot_keys is not None else None))
+
+    def _log_restore_fn(self):
+        cap = self.executor.compiled.log_capacity
+
+        def make():
+            def f(rows_chunk, count, state):
+                return clog.append(state, rows_chunk, count)
+            return f
+        return self._jitted(("log_append",), make)
+
+    def _log_finalize_fn(self):
+        def make():
+            def f(state, epoch_offs, epoch_mask, latest, base):
+                starts = jnp.where(epoch_mask, epoch_offs,
+                                   state.epoch_starts)
+                return state._replace(
+                    epoch_starts=starts,
+                    latest_epoch=jnp.maximum(state.latest_epoch, latest),
+                    epoch_base=jnp.maximum(state.epoch_base, base))
+            return f
+        return self._jitted(("log_finalize",), make)
+
+    def _graft_fn(self, vid: int):
+        def make():
+            def f(carry, new_state, restored_log, sub, flat, rc):
+                ops = list(carry.op_states)
+                ops[vid] = jax.tree_util.tree_map(
+                    lambda live_x, new_x: live_x.at[sub].set(new_x[0]),
+                    ops[vid], new_state)
+                logs = jax.tree_util.tree_map(
+                    lambda s, r: s.at[flat].set(r), carry.logs,
+                    restored_log)
+                return carry._replace(
+                    op_states=tuple(ops), logs=logs,
+                    record_counts=carry.record_counts.at[flat].set(rc))
+            return f
+        return self._jitted(("graft", vid), make)
+
+    def _ring_write_fn(self, ri: int, m: int):
+        """Write an [m, cap] replayed output chunk into ring ``ri`` at
+        steps [base, base+m), keeping only steps in [keep_from, hi)."""
+        def make():
+            def f(el, chunk, base, sub, keep_from, hi):
+                steps = base + jnp.arange(m, dtype=jnp.int32)
+                keep = (steps >= keep_from) & (steps < hi)
+                pos = jnp.where(keep, steps & (el.ring_steps - 1),
+                                el.ring_steps)        # OOB row -> dropped
+                return el._replace(
+                    keys=el.keys.at[pos, sub].set(chunk.keys, mode="drop"),
+                    values=el.values.at[pos, sub].set(chunk.values,
+                                                      mode="drop"),
+                    timestamps=el.timestamps.at[pos, sub].set(
+                        chunk.timestamps, mode="drop"),
+                    valid=el.valid.at[pos, sub].set(chunk.valid,
+                                                    mode="drop"))
+            return f
+        return self._jitted(("ring_write", ri, m), make)
 
     def _patch(self, carry: JobCarry, snap: LeanSnapshot, vid: int,
                sub: int, flat: int, result: rec.ReplayResult,
                det_rows: np.ndarray, from_epoch: int, fence: int,
                n_steps: int) -> JobCarry:
-        """Graft the rebuilt subtask back into the live carry."""
+        """Graft the rebuilt subtask back into the live carry. Every
+        device program here is fixed-shape (chunked appends/writes) so a
+        prewarmed standby pays zero XLA compile on the failure path."""
         compiled = self.executor.compiled
-        # Operator state slice.
-        ops = list(carry.op_states)
-        ops[vid] = jax.tree_util.tree_map(
-            lambda live_x, new_x: live_x.at[sub].set(new_x[0]),
-            ops[vid], result.op_state)
+        ch4 = self._chunk() * DETS_PER_STEP
         # Causal log row: an empty log re-based at the fence offset (the
         # pre-fence rows were truncated by the completed checkpoint — the
-        # lean snapshot deliberately doesn't carry them) + recovered rows.
+        # lean snapshot deliberately doesn't carry them) + recovered rows,
+        # appended in fixed-size chunks.
         ck_head = int(np.asarray(snap.log_heads[flat]))
-        base = jnp.asarray(ck_head, jnp.int32)
         restored = clog.create(compiled.log_capacity, compiled.max_epochs)
+        base = jnp.asarray(ck_head, jnp.int32)
         restored = restored._replace(head=base, tail=base)
         n = det_rows.shape[0]
-        if n > 0:
-            restored = clog.append(restored, jnp.asarray(det_rows), n)
+        app = self._log_restore_fn()
+        for lo in range(0, n, ch4):
+            cnt = min(ch4, n - lo)
+            chunk = np.zeros((ch4, det.NUM_LANES), np.int32)
+            chunk[:cnt] = det_rows[lo:lo + cnt]
+            restored = app(jnp.asarray(chunk),
+                           jnp.asarray(cnt, jnp.int32), restored)
         # Epoch->offset index entries died with the task; rebuild them from
         # the fence-step ledger. Sync blocks anchor at TIMESTAMP rows.
         ts_pos = (np.where((det_rows[:, det.LANE_TAG] == det.TIMESTAMP)
                            & (det_rows[:, det.LANE_RC] == 0))[0]
                   if n > 0 else np.zeros((0,), np.int64))
+        me = compiled.max_epochs
+        epoch_offs = np.zeros((me,), np.int32)
+        epoch_mask = np.zeros((me,), bool)
+        latest = 0
         for e in range(from_epoch, self.executor.epoch_id + 1):
             if e in self._fence_step:
                 step_i = self._fence_step[e] - fence
@@ -633,46 +929,44 @@ class ClusterRunner:
                     off = ck_head + int(ts_pos[step_i])
                 else:
                     off = ck_head + n
-                slot = e % restored.max_epochs
-                restored = restored._replace(
-                    epoch_starts=restored.epoch_starts.at[slot].set(off),
-                    latest_epoch=jnp.maximum(
-                        restored.latest_epoch,
-                        jnp.asarray(e, jnp.int32)))
-        restored = restored._replace(
-            epoch_base=jnp.maximum(restored.epoch_base,
-                                   jnp.asarray(from_epoch, jnp.int32)))
-        logs = jax.tree_util.tree_map(
-            lambda s, r: s.at[flat].set(r), carry.logs, restored)
+                epoch_offs[e % me] = off
+                epoch_mask[e % me] = True
+                latest = max(latest, e)
+        restored = self._log_finalize_fn()(
+            restored, jnp.asarray(epoch_offs), jnp.asarray(epoch_mask),
+            jnp.asarray(latest, jnp.int32),
+            jnp.asarray(from_epoch, jnp.int32))
+        # Operator state slice + log row + record count in one program.
+        rc = snap.record_counts[flat] + result.records_replayed
+        carry = self._graft_fn(vid)(
+            carry, result.op_state, restored,
+            jnp.asarray(sub, jnp.int32), jnp.asarray(flat, jnp.int32), rc)
         # In-flight ring shard reconstruction: write the replayed outputs
         # back into the producer's ring at their original step offsets
         # (reference buildAndLogBuffer — the standby re-cuts identical
-        # buffers and re-logs them so downstream recoveries can be served).
+        # buffers and re-logs them so downstream recoveries can be
+        # served). Only the last ring_steps replayed steps fit; earlier
+        # chunks are masked out (spill-backed replays longer than the
+        # ring must not wrap into newer steps).
         rings = list(carry.out_rings)
-        if vid in compiled.ring_index and result.out_steps is not None \
+        if vid in compiled.ring_index and result.out_chunks is not None \
                 and n_steps > 0:
             ri = compiled.ring_index[vid]
             el = rings[ri]
-            # Only the last ring_steps replayed steps fit in the ring; a
-            # spill-backed replay longer than the ring would otherwise
-            # scatter wrapped duplicate indices (unspecified winner).
-            m = min(n_steps, el.ring_steps)
-            os_ = jax.tree_util.tree_map(
-                lambda x: x[n_steps - m:], result.out_steps)
-            idx = (jnp.asarray(fence + n_steps - m, jnp.int32)
-                   + jnp.arange(m, dtype=jnp.int32)) \
-                & (el.ring_steps - 1)
-            rings[ri] = el._replace(
-                keys=el.keys.at[idx, sub].set(
-                    os_.keys, unique_indices=True),
-                values=el.values.at[idx, sub].set(
-                    os_.values, unique_indices=True),
-                timestamps=el.timestamps.at[idx, sub].set(
-                    os_.timestamps, unique_indices=True),
-                valid=el.valid.at[idx, sub].set(
-                    os_.valid, unique_indices=True))
-        # Record count: checkpoint value + replayed records.
-        rc = snap.record_counts[flat] + result.records_replayed
-        return carry._replace(
-            op_states=tuple(ops), logs=logs, out_rings=tuple(rings),
-            record_counts=carry.record_counts.at[flat].set(rc))
+            keep_from = jnp.asarray(fence + n_steps
+                                    - min(n_steps, el.ring_steps),
+                                    jnp.int32)
+            hi = jnp.asarray(fence + n_steps, jnp.int32)
+            sub_j = jnp.asarray(sub, jnp.int32)
+            ch = self._chunk()
+            for i, chunk in enumerate(result.out_chunks):
+                m = chunk.keys.shape[0]
+                base_i = fence + i * ch
+                if base_i + m <= fence + n_steps - min(n_steps,
+                                                       el.ring_steps):
+                    continue      # wholly before the retained window
+                el = self._ring_write_fn(ri, m)(
+                    el, chunk, jnp.asarray(base_i, jnp.int32), sub_j,
+                    keep_from, hi)
+            rings[ri] = el
+        return carry._replace(out_rings=tuple(rings))

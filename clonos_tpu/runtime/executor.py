@@ -168,6 +168,31 @@ class CompiledJob:
         self.ring_vertices = [v.vertex_id for v in self.job.vertices
                               if self.job.out_edges(v.vertex_id)]
         self.ring_index = {vid: i for i, vid in enumerate(self.ring_vertices)}
+        #: HASH edges whose producer emits statically-keyed slots get a
+        #: compile-time gather plan instead of the sort exchange.
+        self.static_route: Dict[int, routing.StaticRoutePlan] = {}
+        for eidx, e in enumerate(self.job.edges):
+            if e.partition != PartitionType.HASH:
+                continue
+            sk = self.job.vertices[e.src].operator.static_out_keys()
+            if sk is not None:
+                plan = routing.plan_static_hash(
+                    sk, self.job.vertices[e.src].parallelism,
+                    self.job.vertices[e.dst].parallelism,
+                    self.job.num_key_groups, e.capacity)
+                # A plan with overflow slots would drop those records on
+                # EVERY step (the dynamic exchange drops only per-step
+                # excess arrivals) — keep the dynamic semantics then.
+                if len(plan.drop_p) == 0:
+                    self.static_route[eidx] = plan
+
+    def consumer_slot_keys(self, vid: int) -> Optional[np.ndarray]:
+        """Static per-slot input keys of vertex ``vid`` ([P, cap], -1 =
+        unmapped), when its (single) input edge is statically routed."""
+        ins = self.job.in_edges(vid)
+        if len(ins) == 1 and ins[0] in self.static_route:
+            return self.static_route[ins[0]].slot_keys
+        return None
 
     # --- shapes -------------------------------------------------------------
 
@@ -291,7 +316,14 @@ class CompiledJob:
             else:
                 ins = empty((K, p, self.vertex_out_capacity(vid)))
                 consumed = None
-            state, out = v.operator.process_block(op_states[vid], ins, bctx)
+            slot_keys = self.consumer_slot_keys(vid)
+            if slot_keys is not None and hasattr(
+                    v.operator, "process_block_static_keys"):
+                state, out = v.operator.process_block_static_keys(
+                    op_states[vid], ins, bctx, slot_keys)
+            else:
+                state, out = v.operator.process_block(op_states[vid], ins,
+                                                      bctx)
             if consumed is None:
                 # Pure generators "consume" what they emit (their record
                 # count advances with generated records, like the
@@ -307,7 +339,9 @@ class CompiledJob:
             for eidx in job.out_edges(vid):
                 e = job.edges[eidx]
                 dst_p = job.vertices[e.dst].parallelism
-                if e.partition == PartitionType.HASH:
+                if eidx in self.static_route:
+                    r, d = self.static_route[eidx].apply(out)
+                elif e.partition == PartitionType.HASH:
                     r, d = routing.route_hash_block(
                         out, dst_p, job.num_key_groups, e.capacity)
                 elif e.partition == PartitionType.FORWARD:

@@ -1,0 +1,23 @@
+"""Reliable device-completion sync.
+
+``jax.block_until_ready`` can return before work completes on tunneled /
+experimental backends, so timing code must force a real device→host read.
+This is the single shared copy of that workaround (bench.py and the
+tools/ profilers import it).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def device_sync(tree) -> None:
+    """Block until ``tree``'s device work is actually finished by reading
+    one element of one leaf back to the host."""
+    import jax
+    leaves = [x for x in jax.tree_util.tree_leaves(tree)
+              if hasattr(x, "shape")]
+    if not leaves:
+        return
+    x = leaves[0]
+    np.asarray(x.ravel()[0] if getattr(x, "ndim", 0) else x)

@@ -86,6 +86,27 @@ def test_window_block_fires_like_stepwise():
     assert int(jnp.sum(blk[1].valid)) > 0
 
 
+def test_reduce_static_keys_equals_dynamic():
+    """The static-gather aggregation (StaticRoutePlan-fed input) must be
+    bit-identical to the dynamic process_block on the same batch."""
+    rng = np.random.RandomState(5)
+    # Static layout: each slot is bound to a fixed key; some slots unmapped.
+    slot_keys = rng.randint(-1, NK, size=(P, B)).astype(np.int32)
+    keys = np.broadcast_to(np.clip(slot_keys, 0, NK - 1), (K, P, B)).copy()
+    vals = rng.randint(1, 9, size=(K, P, B)).astype(np.int32)
+    valid = (rng.rand(K, P, B) < 0.6) & (slot_keys >= 0)[None]
+    batch = zero_invalid(RecordBatch(
+        jnp.asarray(keys), jnp.asarray(vals),
+        jnp.zeros((K, P, B), jnp.int32), jnp.asarray(valid)))
+    op = KeyedReduceOperator(num_keys=NK)
+    state = op.init_state(P)
+    bctx = _bctx()
+    dyn = jax.jit(op.process_block)(state, batch, bctx)
+    sta = jax.jit(lambda s, b, c: op.process_block_static_keys(
+        s, b, c, slot_keys))(state, batch, bctx)
+    _assert_equal(dyn, sta)
+
+
 def test_two_input_union_block_equals_scan():
     op = UnionOperator(capacity=2 * B)
     left, right = _batches(1), _batches(2)

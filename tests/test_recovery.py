@@ -103,6 +103,57 @@ def test_single_failure_recovery_bit_identical():
     _carries_equal(r.executor.carry, golden.executor.carry)
 
 
+def test_prewarmed_recovery_bit_identical_and_reusable():
+    """Warm standby: prewarm_recovery() compiles the failure path up
+    front; recovery still lands bit-identically, and a second failure of
+    the same subtask reuses every compiled program."""
+    golden = _runner(TIMES)
+    golden.run_epoch()
+    golden.step()
+    golden.step()
+
+    r = _runner(TIMES)
+    warm_s = r.prewarm_recovery()
+    assert warm_s >= 0
+    r.run_epoch()
+    r.step()
+    r.step()
+    r.inject_failure([3])
+    r.recover()
+    _carries_equal(r.executor.carry, golden.executor.carry)
+    # Second failure of the same subtask: full protocol again, warm.
+    r.inject_failure([3])
+    report2 = r.recover()
+    assert report2.failed_subtasks == (3,)
+    _carries_equal(r.executor.carry, golden.executor.carry)
+    golden.step()
+    r.step()
+    _carries_equal(r.executor.carry, golden.executor.carry)
+
+
+def test_zero_step_recovery_right_after_checkpoint():
+    """Failure exactly at a completed-checkpoint fence: nothing to replay
+    (n_steps=0); recovery must restore the checkpoint state and not trip
+    on empty determinant streams."""
+    golden = _runner(TIMES)
+    golden.run_epoch()
+    r = _runner(TIMES)
+    r.run_epoch()
+    r.inject_failure([3])
+    report = r.recover()
+    assert report.steps_replayed == 0
+    _carries_equal(r.executor.carry, golden.executor.carry)
+    golden.step()
+    r.step()
+    _carries_equal(r.executor.carry, golden.executor.carry)
+
+
+def test_prewarm_requires_standby():
+    r = ClusterRunner(_job(), steps_per_epoch=3, num_standby=0, seed=3)
+    with pytest.raises(rec.RecoveryError):
+        r.prewarm_recovery()
+
+
 def test_source_failure_recovery_bit_identical():
     golden = _runner(TIMES)
     golden.run_epoch()

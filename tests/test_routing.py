@@ -116,14 +116,18 @@ def _rand_block(rng, K, P, B, vocab=37, fill=0.7):
         jnp.asarray(valid)))
 
 
-@pytest.mark.parametrize("cap", [4, 16, 64])
-def test_block_routes_bit_identical_to_per_step(cap):
-    """The one-flat-sort block exchange must equal vmapping the per-step
-    exchange, including overflow-drop accounting (the executor switched to
-    the block form for speed; semantics are pinned here)."""
+@pytest.mark.parametrize("cap,K,P,B", [
+    (4, 7, 3, 16), (16, 7, 3, 16), (64, 7, 3, 16),
+    # P*B >= _FLAT_SORT_MIN_N exercises the flat-sort branch.
+    (32, 5, 8, 600),
+])
+def test_block_routes_bit_identical_to_per_step(cap, K, P, B):
+    """The block exchange (both the flat-sort branch and the small-n vmap
+    branch) must equal vmapping the per-step exchange, including
+    overflow-drop accounting (the executor switched to the block form for
+    speed; semantics are pinned here)."""
     import jax
     rng = np.random.RandomState(3)
-    K, P, B = 7, 3, 16
     batch = _rand_block(rng, K, P, B)
     for T, G in [(4, 8), (1, 4), (5, 20)]:
         r1, d1 = jax.vmap(
@@ -154,6 +158,55 @@ def test_block_routes_bit_identical_to_per_step(cap):
         for a, b in zip(jax.tree_util.tree_leaves((r1, d1)),
                         jax.tree_util.tree_leaves((r2, d2))):
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_static_route_plan_matches_dynamic_multiset():
+    """StaticRoutePlan routes the same per-(step,target) record multiset
+    as the dynamic hash exchange (layout differs: static slots keep holes
+    instead of compacting)."""
+    import jax
+    rng = np.random.RandomState(11)
+    K, P, NK, G, T, CAP = 5, 3, 29, 8, 4, 32
+    slot_keys = np.arange(NK, dtype=np.int32)
+    plan = routing.plan_static_hash(slot_keys, P, T, G, CAP)
+    # Dense-table emission: slot i carries key i; random validity.
+    keys = np.broadcast_to(slot_keys, (K, P, NK)).copy()
+    vals = rng.randint(1, 100, size=(K, P, NK)).astype(np.int32)
+    ts = rng.randint(0, 50, size=(K, P, NK)).astype(np.int32)
+    valid = rng.rand(K, P, NK) < 0.6
+    batch = records.zero_invalid(records.RecordBatch(
+        jnp.asarray(keys), jnp.asarray(vals), jnp.asarray(ts),
+        jnp.asarray(valid)))
+    r_static, d_static = plan.apply(batch)
+    r_dyn, d_dyn = routing.route_hash_block(batch, T, G, CAP)
+    for k in range(K):
+        for t in range(T):
+            def multiset(r):
+                m = np.asarray(r.valid[k, t])
+                return sorted(zip(np.asarray(r.keys[k, t])[m].tolist(),
+                                  np.asarray(r.values[k, t])[m].tolist(),
+                                  np.asarray(r.timestamps[k, t])[m].tolist()))
+            assert multiset(r_static) == multiset(r_dyn), (k, t)
+    assert int(jnp.sum(d_static)) == 0 == int(jnp.sum(d_dyn))
+    # slot_keys metadata matches what actually flows in mapped slots.
+    assert np.all((plan.slot_keys >= 0) == plan.ok)
+
+
+def test_static_route_plan_drop_accounting():
+    """Capacity overflow drops whole static slots and counts them."""
+    NK, P, T, G, CAP = 16, 2, 1, 4, 8
+    plan = routing.plan_static_hash(
+        np.arange(NK, dtype=np.int32), P, T, G, CAP)
+    # All 16*2=32 slots target subtask 0; capacity 8 -> 24 static drops.
+    assert plan.ok.sum() == CAP
+    assert len(plan.drop_p) == NK * P - CAP
+    batch = records.RecordBatch(
+        jnp.broadcast_to(jnp.arange(NK, dtype=jnp.int32), (3, P, NK)),
+        jnp.ones((3, P, NK), jnp.int32), jnp.zeros((3, P, NK), jnp.int32),
+        jnp.ones((3, P, NK), jnp.bool_))
+    routed, dropped = plan.apply(batch)
+    assert int(routed.valid.sum()) == 3 * CAP
+    assert np.all(np.asarray(dropped) == NK * P - CAP)
 
 
 def test_forward_identity():

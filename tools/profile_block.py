@@ -1,14 +1,17 @@
 #!/usr/bin/env python
-"""Micro-profile of the steady-state block program's pieces at bench shapes.
+"""Micro-profile of the steady-state block program's pieces at bench shapes
+(K=512, P=8), plus the FULL fused block program from the bench topology —
+so optimization targets the real hot spot, not a guess.
 
-Times each vertex's process_block, each exchange, the ring append, and the
-determinant log append in isolation (same shapes as bench.py's topology with
-K=512, P=8), plus the full fused block — so optimization targets the real
-hot spot, not a guess.
+Timing method: enqueue n calls, one d2h sync at the end (block_until_ready
+is unreliable on the tunneled backend), subtract a measured round-trip.
 """
 
-import json
+import os
+import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp
@@ -19,39 +22,29 @@ from clonos_tpu.api.operators import (BlockContext, SyntheticSource,
                                       KeyedReduceOperator, SinkOperator)
 from clonos_tpu.api.records import RecordBatch
 from clonos_tpu.parallel import routing
-from clonos_tpu.causal import log as clog
-from clonos_tpu.inflight import log as ifl
 
 K, P, B, CAP, NK = 512, 8, 128, 1024, 997
-RING_STEPS = 4096
-LOG_CAP = 1 << 14
-L = 32
 
 
-def _sync(tree):
-    """Force real device completion (block_until_ready is a no-op on the
-    tunneled backend): read one element of one leaf d2h."""
-    leaves = [x for x in jax.tree_util.tree_leaves(tree)
-              if hasattr(x, "shape")]
-    x = leaves[0]
-    np.asarray(x.ravel()[0] if x.ndim else x)
+from clonos_tpu.utils.devsync import device_sync as _sync  # noqa: E402
 
 
 def timeit(name, fn, *args, n=10):
-    """Enqueue n calls, sync once at the end, subtract the measured sync
-    round-trip; TPU executes the queue serially so total/n is per-call."""
     jfn = jax.jit(fn)
     out = jfn(*args)
     _sync(out)
-    t0 = time.monotonic()
-    _sync(out)
-    rt = time.monotonic() - t0          # pure round-trip latency
+    rts = []
+    for _ in range(3):
+        t0 = time.monotonic()
+        _sync(out)
+        rts.append(time.monotonic() - t0)
+    rt = min(rts)
     t0 = time.monotonic()
     for _ in range(n):
         out = jfn(*args)
     _sync(out)
-    ms = ((time.monotonic() - t0) - rt) / n * 1e3
-    print(f"{name:44s} {ms:9.2f} ms")
+    ms = max(((time.monotonic() - t0) - rt) / n * 1e3, 0.0)
+    print(f"{name:48s} {ms:9.2f} ms")
     return ms
 
 
@@ -63,8 +56,8 @@ def main():
         epoch=jnp.zeros((), jnp.int32), step0=jnp.zeros((), jnp.int32),
         subtask=jnp.arange(P, dtype=jnp.int32))
 
-    def mkbatch(k, p, b, fill):
-        keys = jnp.asarray(rng.randint(0, NK, (k, p, b)), jnp.int32)
+    def mkbatch(k, p, b, fill, vocab=NK):
+        keys = jnp.asarray(rng.randint(0, vocab, (k, p, b)), jnp.int32)
         vals = jnp.ones((k, p, b), jnp.int32)
         ts = jnp.zeros((k, p, b), jnp.int32)
         valid = jnp.asarray(
@@ -72,53 +65,48 @@ def main():
         valid = jnp.broadcast_to(valid, (k, p, b))
         return RecordBatch(keys, vals, ts, valid)
 
-    src = SyntheticSource(vocab=NK, batch_size=B)
     win = TumblingWindowCountOperator(num_keys=NK, window_size=1 << 30)
     red = KeyedReduceOperator(num_keys=NK)
-    snk = SinkOperator()
-
-    src_state = src.init_state(P)
-    win_state = win.init_state(P)
-    red_state = red.init_state(P)
-    snk_state = snk.init_state(P)
 
     src_out = mkbatch(K, P, B, B)          # [K,P,128]
     win_in = mkbatch(K, P, CAP, 128)       # [K,P,1024], ~128 valid
     win_out = mkbatch(K, P, NK, 200)       # [K,P,997]
-    red_in = mkbatch(K, P, CAP, 200)
 
-    timeit("source.process_block", lambda s: src.process_block(s, None, bctx),
-           src_state)
-    timeit("window.process_block", lambda s, b: win.process_block(s, b, bctx),
-           win_state, win_in)
-    timeit("reduce.process_block", lambda s, b: red.process_block(s, b, bctx),
-           red_state, red_in)
-    timeit("sink.process_block", lambda s, b: snk.process_block(s, b, bctx),
-           snk_state, red_in)
+    plan = routing.plan_static_hash(
+        np.arange(NK, dtype=np.int32), P, P, 64, CAP)
+    red_in, _ = jax.jit(plan.apply)(win_out)
 
-    timeit("route_hash src->win [K,P,128]->1024",
-           lambda b: jax.vmap(lambda x: routing.route_hash(
-               x, P, 64, CAP))(b), src_out)
-    timeit("route_hash win->red [K,P,997]->1024",
-           lambda b: jax.vmap(lambda x: routing.route_hash(
-               x, P, 64, CAP))(b), win_out)
-    timeit("route_forward red->sink",
-           lambda b: jax.vmap(lambda x: routing.route_forward(
-               x, CAP))(b), red_in)
+    timeit("window.process_block [K,P,1024]",
+           lambda s, b: win.process_block(s, b, bctx),
+           win.init_state(P), win_in)
+    timeit("reduce.process_block dynamic [K,P,1024]",
+           lambda s, b: red.process_block(s, b, bctx),
+           red.init_state(P), red_in)
+    timeit("reduce.process_block_static_keys",
+           lambda s, b: red.process_block_static_keys(
+               s, b, bctx, plan.slot_keys),
+           red.init_state(P), red_in)
 
-    ring = ifl.create(RING_STEPS, P, NK, 16)
-    timeit("ring append [4096,8,997] no-donate",
-           lambda r, b: ifl.append_block(r, b), ring, win_out)
+    timeit("route_hash_block src->win [K,P,128]->1024",
+           lambda b: routing.route_hash_block(b, P, 64, CAP), src_out)
+    timeit("route_hash_block win->red [K,P,997]->1024",
+           lambda b: routing.route_hash_block(b, P, 64, CAP), win_out)
+    timeit("static plan.apply win->red",
+           lambda b: plan.apply(b), win_out)
 
-    logs = jax.vmap(lambda _: clog.create(LOG_CAP, 16))(jnp.arange(L))
-    rows = jnp.zeros((L, K * 4, 8), jnp.int32)
-    timeit("clog.v_append_full [32,2048,8]",
-           lambda l, r: clog.v_append_full(l, r), logs, rows)
-    R = 192
-    reps = jax.vmap(lambda _: clog.create(LOG_CAP, 16))(jnp.arange(R))
-    rrows = jnp.zeros((R, K * 4, 8), jnp.int32)
-    timeit(f"replica v_append_full [{R},2048,8]",
-           lambda l, r: clog.v_append_full(l, r), reps, rrows)
+    # --- the real thing: bench topology full block --------------------------
+    sys.argv = ["profile"]
+    import bench
+    from clonos_tpu.runtime.executor import LocalExecutor
+    job = bench.build_job()
+    ex = LocalExecutor(job, steps_per_epoch=K, log_capacity=1 << 13,
+                       max_epochs=16, inflight_ring_steps=1 << 10, seed=7)
+    bi = ex._next_block_inputs(K)
+    carry = ex.carry
+    ms = timeit("FULL run_block (bench topology, K=512)",
+                lambda c, i: ex.compiled.run_block(c, i), carry, bi)
+    print(f"  -> steady-state ceiling ~{K * P * B / ms * 1e3 / 1e6:.2f} "
+          f"M records/s")
 
 
 if __name__ == "__main__":
