@@ -60,14 +60,16 @@ def main():
     from clonos_tpu.causal import recovery as rec
 
     job = build_job()
-    # Log capacity sized to hold FILL_EPOCHS * STEPS_PER_EPOCH * 4 rows.
+    # Log capacity sized to hold FILL_EPOCHS * STEPS_PER_EPOCH * 4 sync
+    # rows plus control-plane determinants (SOURCE_CHECKPOINT per trigger).
     need = FILL_EPOCHS * STEPS_PER_EPOCH * DETS_PER_STEP
-    cap = 1 << max(need - 1, 1).bit_length()
+    cap = 1 << need.bit_length()
     runner = ClusterRunner(job, steps_per_epoch=STEPS_PER_EPOCH,
                            log_capacity=cap, max_epochs=16,
                            inflight_ring_steps=1 << max(
                                FILL_EPOCHS * STEPS_PER_EPOCH, 2
                            ).bit_length(),
+                           recovery_block_steps=2048,
                            seed=7)
 
     t_warm0 = time.monotonic()

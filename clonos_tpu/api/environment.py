@@ -53,6 +53,8 @@ class DataStream:
         if partition is None:
             if self._keyed:
                 partition = PartitionType.HASH
+            elif getattr(self, "_force_rebalance", False):
+                partition = PartitionType.REBALANCE
             elif p == self._vertex.parallelism:
                 partition = PartitionType.FORWARD
             else:
@@ -100,6 +102,8 @@ class DataStream:
         for side in (self, other):
             if side._keyed:
                 part = PartitionType.HASH
+            elif getattr(side, "_force_rebalance", False):
+                part = PartitionType.REBALANCE
             elif side._vertex.parallelism == p:
                 part = PartitionType.FORWARD
             else:

@@ -290,7 +290,8 @@ class KeyedReduceOperator(Operator):
         nk = self.num_keys
         acc0 = state["acc"]                               # [P, nk]
         contrib, _ = keyed_hist(batches.keys, batches.values,
-                                batches.valid, nk)        # [K, P, nk]
+                                batches.valid, nk,
+                                want_counts=False)        # [K, P, nk]
         cum = jnp.cumsum(contrib, axis=0)                 # inclusive prefix
         acc_end = acc0[None] + cum                        # [K, P, nk]
         out_vals = jnp.where(
@@ -417,7 +418,8 @@ class TumblingWindowCountOperator(Operator):
 
         from clonos_tpu.ops.histogram import keyed_hist
         contrib, _ = keyed_hist(batches.keys, batches.values,
-                                batches.valid, nk)                # [K, P, nk]
+                                batches.valid, nk,
+                                want_counts=False)                # [K, P, nk]
         cum = jnp.cumsum(contrib, axis=0)                         # [K, P, nk]
         cum_excl = cum - contrib
 

@@ -40,9 +40,14 @@ class RecordBatch(NamedTuple):
 
 
 def empty(shape) -> RecordBatch:
+    # Distinct buffers per field: sharing one zeros array across leaves
+    # breaks buffer donation (the executor donates the carry, and XLA
+    # rejects donating the same buffer twice).
     shape = tuple(shape) if not isinstance(shape, int) else (shape,)
-    z = jnp.zeros(shape, jnp.int32)
-    return RecordBatch(z, z, z, jnp.zeros(shape, jnp.bool_))
+    return RecordBatch(jnp.zeros(shape, jnp.int32),
+                       jnp.zeros(shape, jnp.int32),
+                       jnp.zeros(shape, jnp.int32),
+                       jnp.zeros(shape, jnp.bool_))
 
 
 def make(keys, values=None, timestamps=None, capacity=None) -> RecordBatch:

@@ -150,6 +150,11 @@ class LogReplayer:
         if key not in cache:
             cache[key] = jax.jit(self._replay_block)
         self._jit_block = cache[key]
+        skey = ("tslice", block_steps)
+        if skey not in cache:
+            cache[skey] = jax.jit(lambda v, lo: jax.lax.dynamic_slice(
+                v, (lo,), (block_steps,)))
+        self._jit_tslice = cache[skey]
 
     def _replay_block(self, op_state, batches, times, rngs, subtask):
         """One block of replay: state has leading dim 1 (the failed subtask
@@ -164,16 +169,21 @@ class LogReplayer:
             left, right = batches
             new_state, out = self.operator.process_block(
                 op_state, (lift(left), lift(right)), bctx)
+            consumed = left.count().sum() + right.count().sum()
         elif self.in_slot_keys is not None and hasattr(
                 self.operator, "process_block_static_keys"):
             new_state, out = self.operator.process_block_static_keys(
                 op_state, lift(batches), bctx, self.in_slot_keys)
+            consumed = batches.count().sum()
         else:
             new_state, out = self.operator.process_block(
                 op_state, lift(batches), bctx)
-        # Drop the singleton P dim: out [k, 1, cap] -> [k, cap].
+            consumed = batches.count().sum()
+        # Drop the singleton P dim: out [k, 1, cap] -> [k, cap]. Emit
+        # counts and the consumed-record total ride the same program (an
+        # eager op after the call costs a ~9ms tunnel dispatch each).
         out = jax.tree_util.tree_map(lambda x: x[:, 0], out)
-        return new_state, out
+        return new_state, out, out.count(), consumed
 
     #: per-step sync row layout (must match executor.DETS_PER_STEP appends)
     LAYOUT = (det.TIMESTAMP, det.RNG, det.ORDER, det.BUFFER_BUILT)
@@ -256,6 +266,16 @@ class LogReplayer:
         emit_chunks: List[jnp.ndarray] = []
         consumed_parts: List[jnp.ndarray] = []
         ch = self.block_steps
+        # One h2d of the whole (pad-extended) time/rng streams; per-chunk
+        # views are prewarmed dynamic slices — each h2d costs a full
+        # tunnel round-trip, so per-chunk uploads dominate warm replay.
+        npad = -(-max(n, 1) // ch) * ch
+        t_all = np.full((npad,), times_np[n - 1] if n else 0, np.int32)
+        r_all = np.full((npad,), rngs_np[n - 1] if n else 0, np.int32)
+        t_all[:n] = times_np[:n]
+        r_all[:n] = rngs_np[:n]
+        t_dev = jnp.asarray(t_all)
+        r_dev = jnp.asarray(r_all)
         lo = 0
         ci = 0
         while lo < n:
@@ -277,24 +297,19 @@ class LogReplayer:
             if kk < ch and not pad and (chunked or
                                         plan.input_steps is None):
                 chunk = jax.tree_util.tree_map(lambda x: x[:kk], chunk)
-            if plan.input_steps is not None:
-                leaves = [b for b in jax.tree_util.tree_leaves(
-                    chunk, is_leaf=lambda x: isinstance(x, RecordBatch))]
-                consumed_parts.append(
-                    sum(b.count().sum() for b in leaves))
-            if pad:
-                t_in = np.full((ch,), times_np[hi - 1], np.int32)
-                r_in = np.full((ch,), rngs_np[hi - 1], np.int32)
-                t_in[:kk] = times_np[lo:hi]
-                r_in[:kk] = rngs_np[lo:hi]
+            if pad or kk == ch:
+                lo_j = jnp.asarray(lo, jnp.int32)
+                t_in = self._jit_tslice(t_dev, lo_j)
+                r_in = self._jit_tslice(r_dev, lo_j)
             else:
-                t_in = times_np[lo:hi]
-                r_in = rngs_np[lo:hi]
-            state, out = self._jit_block(
-                state, chunk, jnp.asarray(t_in), jnp.asarray(r_in),
-                subtask)
+                t_in = jnp.asarray(times_np[lo:hi])
+                r_in = jnp.asarray(rngs_np[lo:hi])
+            state, out, counts, consumed = self._jit_block(
+                state, chunk, t_in, r_in, subtask)
+            if plan.input_steps is not None:
+                consumed_parts.append(consumed)
             out_chunks.append(out)
-            emit_chunks.append(out.count())
+            emit_chunks.append(counts)
             lo = hi
             ci += 1
         if emit_chunks:
@@ -310,22 +325,28 @@ class LogReplayer:
         # rebuilt log must extend the recovered one bit-for-bit. Sync blocks
         # are re-derived from the replay; async rows are spliced back at
         # their recorded positions (append-even-during-replay invariant).
-        # Pure numpy: only emit_counts crosses d2h; the old per-lane jnp
-        # construction cost ~300ms of tiny dispatches on the warm path.
-        blocks = np.zeros((n, k, det.NUM_LANES), np.int32)
-        blocks[:, 0, det.LANE_TAG] = det.TIMESTAMP
-        blocks[:, 0, det.LANE_P] = np.where(times_np < 0, -1, 0)
-        blocks[:, 0, det.LANE_P + 1] = times_np
-        blocks[:, 1, det.LANE_TAG] = det.RNG
-        blocks[:, 1, det.LANE_P] = rngs_np
-        blocks[:, 2, det.LANE_TAG] = det.ORDER
-        blocks[:, 3, det.LANE_TAG] = det.BUFFER_BUILT
-        blocks[:, 3, det.LANE_P] = emit_np
-        rebuilt = rows[:used].copy()
-        sync_pos = (ts_idx[:, None] + np.arange(k)[None, :])    # [n, k]
-        rebuilt[sync_pos.ravel()] = blocks.reshape(n * k, det.NUM_LANES)
+        # Clean case (no async rows, real recovered determinants): the
+        # re-derived sync values differ from the recorded rows only in the
+        # BUFFER_BUILT payload, and verify() checks exactly that equality —
+        # so the rebuilt stream IS the recovered prefix, no copy needed.
+        if not async_events and plan.verify_outputs:
+            rebuilt = rows[:used]
+        else:
+            blocks = np.zeros((n, k, det.NUM_LANES), np.int32)
+            blocks[:, 0, det.LANE_TAG] = det.TIMESTAMP
+            blocks[:, 0, det.LANE_P] = np.where(times_np < 0, -1, 0)
+            blocks[:, 0, det.LANE_P + 1] = times_np
+            blocks[:, 1, det.LANE_TAG] = det.RNG
+            blocks[:, 1, det.LANE_P] = rngs_np
+            blocks[:, 2, det.LANE_TAG] = det.ORDER
+            blocks[:, 3, det.LANE_TAG] = det.BUFFER_BUILT
+            blocks[:, 3, det.LANE_P] = emit_np
+            rebuilt = rows[:used].copy()
+            sync_pos = (ts_idx[:, None] + np.arange(k)[None, :])  # [n, k]
+            rebuilt[sync_pos.ravel()] = blocks.reshape(
+                n * k, det.NUM_LANES)
 
-        consumed = (int(np.asarray(sum(consumed_parts)))
+        consumed = (int(np.asarray(jnp.stack(consumed_parts)).sum())
                     if plan.input_steps is not None and consumed_parts
                     else 0 if plan.input_steps is not None
                     else int(emit_np.sum()))

@@ -285,24 +285,62 @@ class ReplayIterator:
                         if from_epoch <= e <= to_epoch]
         self._log = log
         self._skip = skip_steps
+        self._stop = False
         self._t = threading.Thread(target=self._produce, daemon=True)
         self._t.start()
 
-    def _produce(self):
-        first = True
-        for e in self._epochs:
-            start, batch = self._log.load_epoch(e)
-            n = batch.keys.shape[0]
-            lo = self._skip if first else 0
-            first = False
-            for i in range(lo, n):
-                self._q.put((start + i, jax.tree_util.tree_map(
-                    lambda x: x[i], batch)))
-        self._q.put(None)
+    def close(self) -> None:
+        """Release the producer if the consumer stops early (a bounded
+        prefetch queue would otherwise block the thread forever with an
+        epoch batch pinned in memory)."""
+        self._stop = True
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
 
-    def __iter__(self) -> Iterator[Tuple[int, RecordBatch]]:
+    def _produce(self):
+        # Epoch-granular prefetch: the producer thread reads files ahead
+        # while the consumer drains — the reference's async-read deques.
+        for e in self._epochs:
+            if self._stop:
+                return
+            start, batch = self._log.load_epoch(e)
+            while not self._stop:
+                try:
+                    self._q.put((start, batch), timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
+            if self._stop:
+                return
+        while not self._stop:
+            try:
+                self._q.put(None, timeout=0.1)
+                return
+            except queue.Full:
+                continue
+
+    def epochs(self) -> Iterator[Tuple[int, RecordBatch]]:
+        """Prefetched (start_step, stacked steps) per retained epoch —
+        the chunk-assembly feed for recovery's spill reads."""
+        first = True
         while True:
             item = self._q.get()
             if item is None:
                 return
-            yield item
+            start, batch = item
+            if first and self._skip:
+                start = start + self._skip
+                batch = jax.tree_util.tree_map(
+                    lambda x: x[self._skip:], batch)
+            first = False
+            yield start, batch
+
+    def __iter__(self) -> Iterator[Tuple[int, RecordBatch]]:
+        for start, batch in self.epochs():
+            n = batch.keys.shape[0]
+            for i in range(n):
+                yield (start + i, jax.tree_util.tree_map(
+                    lambda x, i=i: x[i], batch))

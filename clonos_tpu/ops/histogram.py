@@ -53,6 +53,24 @@ def _hist_kernel(keys_ref, vals_ref, sum_ref, cnt_ref):
     cnt_ref[:] = cnts
 
 
+def _hist_kernel_sums(keys_ref, vals_ref, sum_ref):
+    # Sums-only variant: half the vector work of _hist_kernel (the keyed
+    # aggregation operators never use the counts).
+    rt, b = keys_ref.shape
+    nkp = sum_ref.shape[1]
+    nchunks = b // _COL_CHUNK
+
+    def body(i, sums):
+        kc = keys_ref[:, pl.ds(i * _COL_CHUNK, _COL_CHUNK)]
+        vc = vals_ref[:, pl.ds(i * _COL_CHUNK, _COL_CHUNK)]
+        iota = jax.lax.broadcasted_iota(jnp.int32, (rt, _COL_CHUNK, nkp), 2)
+        oh = kc[:, :, None] == iota
+        return sums + jnp.sum(jnp.where(oh, vc[:, :, None], 0), axis=1)
+
+    sum_ref[:] = jax.lax.fori_loop(
+        0, nchunks, body, jnp.zeros((rt, nkp), jnp.int32))
+
+
 def _pad_to(x: jnp.ndarray, axis: int, mult: int,
             fill: int = 0) -> jnp.ndarray:
     n = x.shape[axis]
@@ -64,8 +82,9 @@ def _pad_to(x: jnp.ndarray, axis: int, mult: int,
     return jnp.pad(x, widths, constant_values=fill)
 
 
-@functools.partial(jax.jit, static_argnums=(3, 4))
-def _hist_pallas(keys, vals, valid, nk: int, interpret: bool):
+@functools.partial(jax.jit, static_argnums=(3, 4, 5))
+def _hist_pallas(keys, vals, valid, nk: int, interpret: bool,
+                 want_counts: bool = True):
     r, b = keys.shape
     nkp = -(-nk // _COL_CHUNK) * _COL_CHUNK
     # Invalid records AND pad slots get key -1 (matches nothing) — a 0-pad
@@ -80,6 +99,16 @@ def _hist_pallas(keys, vals, valid, nk: int, interpret: bool):
                            memory_space=pltpu.VMEM)
     spec_out = pl.BlockSpec((_ROW_TILE, nkp), lambda i: (i, 0),
                             memory_space=pltpu.VMEM)
+    if not want_counts:
+        sums = pl.pallas_call(
+            _hist_kernel_sums,
+            out_shape=jax.ShapeDtypeStruct((rp, nkp), jnp.int32),
+            grid=grid,
+            in_specs=[spec_in, spec_in],
+            out_specs=spec_out,
+            interpret=interpret,
+        )(k, v)
+        return sums[:r, :nk], None
     sums, cnts = pl.pallas_call(
         _hist_kernel,
         out_shape=(jax.ShapeDtypeStruct((rp, nkp), jnp.int32),
@@ -105,7 +134,7 @@ def _hist_xla(keys, vals, valid, nk: int):
 
 
 def keyed_hist(keys: jnp.ndarray, vals: jnp.ndarray, valid: jnp.ndarray,
-               nk: int, force: str = ""):
+               nk: int, force: str = "", want_counts: bool = True):
     """Per-row keyed sums and counts.
 
     ``keys/vals/valid``: ``[..., B]`` (any leading dims, flattened to rows).
@@ -113,7 +142,9 @@ def keyed_hist(keys: jnp.ndarray, vals: jnp.ndarray, valid: jnp.ndarray,
     sum of ``vals`` and the count of records carrying each key in
     ``[0, nk)``. Out-of-range keys are dropped (scatter ``mode=drop``
     parity). ``force``: "pallas" | "interpret" | "xla" | "" (auto: pallas
-    on TPU, xla elsewhere).
+    on TPU, xla elsewhere). ``want_counts=False`` skips the count output
+    (returned as None) — half the kernel work; the aggregation operators
+    only need sums.
     """
     lead = keys.shape[:-1]
     b = keys.shape[-1]
@@ -125,11 +156,14 @@ def keyed_hist(keys: jnp.ndarray, vals: jnp.ndarray, valid: jnp.ndarray,
     mf = valid.reshape(r, b)
     mode = force or ("pallas" if jax.default_backend() == "tpu" else "xla")
     if mode == "pallas":
-        sums, cnts = _hist_pallas(kf, vf, mf, nk, False)
+        sums, cnts = _hist_pallas(kf, vf, mf, nk, False, want_counts)
     elif mode == "interpret":
-        sums, cnts = _hist_pallas(kf, vf, mf, nk, True)
+        sums, cnts = _hist_pallas(kf, vf, mf, nk, True, want_counts)
     else:
         # Out-of-range guard to mirror mode="drop" exactly.
         ok = mf & (kf >= 0) & (kf < nk)
         sums, cnts = _hist_xla(jnp.where(ok, kf, 0), vf, ok, nk)
-    return sums.reshape(lead + (nk,)), cnts.reshape(lead + (nk,))
+        if not want_counts:
+            cnts = None
+    return (sums.reshape(lead + (nk,)),
+            cnts.reshape(lead + (nk,)) if cnts is not None else None)

@@ -31,6 +31,7 @@ import time
 from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 
@@ -47,6 +48,14 @@ class CompletedCheckpoint:
 class CheckpointStorage:
     """Storage SPI (reference CheckpointStorage / state backends §1 L10)."""
 
+    #: True = the coordinator materializes the carry to host numpy before
+    #: write (durable storage). False = the storage accepts device
+    #: references: jax arrays are immutable, so holding references IS a
+    #: consistent snapshot with zero d2h cost — the right semantics for
+    #: the in-process MiniCluster analog, where the epoch fence would
+    #: otherwise pay a synchronous multi-hundred-ms tunnel transfer.
+    wants_host = True
+
     def write(self, ckpt: CompletedCheckpoint) -> None:
         raise NotImplementedError
 
@@ -61,6 +70,8 @@ class CheckpointStorage:
 
 
 class InMemoryCheckpointStorage(CheckpointStorage):
+    wants_host = False
+
     def __init__(self):
         self._store: Dict[int, CompletedCheckpoint] = {}
 
@@ -168,16 +179,30 @@ class CheckpointCoordinator:
     # --- trigger / ack / complete -------------------------------------------
 
     def trigger(self, checkpoint_id: int, carry,
-                async_write: bool = True) -> None:
+                async_write: bool = True, owned: bool = False) -> None:
         """Fence checkpoint ``checkpoint_id`` over the given carry. The
-        carry must be the state exactly at the epoch boundary."""
+        carry must be the state exactly at the epoch boundary.
+
+        ``owned=True`` promises the caller passed buffers nothing else
+        will mutate or donate (e.g. executor.lean_snapshot's deep copy);
+        otherwise device-kept storage defensively copies — the executor
+        donates its live carry into later programs, which would delete
+        referenced buffers out from under the checkpoint."""
         if checkpoint_id in self._ignored:
             return
         self._pending[checkpoint_id] = set(range(self.num_subtasks))
         snap_start = time.monotonic()
+        if not self.storage.wants_host and not owned:
+            # The defensive copy must happen BEFORE returning to the
+            # caller: with async_write the executor's next block would
+            # donate (delete) the referenced buffers while the writer
+            # thread still points at them.
+            carry = jax.tree_util.tree_map(
+                lambda x: jnp.asarray(x).copy(), carry)
 
         def _write():
-            host = carry_to_host(carry)
+            host = (carry_to_host(carry) if self.storage.wants_host
+                    else carry)
             ckpt = CompletedCheckpoint(
                 checkpoint_id=checkpoint_id, carry=host,
                 wall_time=snap_start, size_bytes=carry_nbytes(host))

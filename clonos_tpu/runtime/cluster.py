@@ -33,6 +33,7 @@ state — executor.canonical_carry.
 from __future__ import annotations
 
 import dataclasses
+import os
 import time as _time
 from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
 
@@ -136,7 +137,9 @@ class ClusterRunner:
     def __init__(self, job: JobGraph, steps_per_epoch: int = 8,
                  num_standby: int = 1, heartbeat_timeout_s: float = 5.0,
                  checkpoint_dir: Optional[str] = None,
-                 prewarm: bool = False, **executor_kw):
+                 prewarm: bool = False,
+                 recovery_block_steps: Optional[int] = None,
+                 **executor_kw):
         self.job = job
         self.executor = LocalExecutor(job, steps_per_epoch=steps_per_epoch,
                                       **executor_kw)
@@ -177,6 +180,33 @@ class ClusterRunner:
         #: lazily and by prewarm_recovery() (warm standby: no XLA compile
         #: in the failure path).
         self._rjit: Dict[Any, Any] = {}
+        self._last_records_total = 0
+        # Host epoch control plane (reference EpochTrackerImpl): the
+        # listener bus + record counting driven from the fused per-epoch
+        # health read; checkpoint completions fan out through it.
+        from clonos_tpu.causal.epoch import EpochTracker
+        self.epoch_tracker = EpochTracker()
+        self.coordinator.subscribe_completion(
+            self.epoch_tracker.notify_checkpoint_complete)
+        #: flat subtask -> ProcessingTimeService; timers fire at block
+        #: boundaries on causal time and log TIMER_TRIGGER determinants
+        #: (reference SystemProcessingTimeService.java:50,79-114).
+        self.timer_services: Dict[int, Any] = {}
+        self.executor.block_listeners.append(self._advance_timers)
+        #: source subtasks (no input edges): their logs record
+        #: SOURCE_CHECKPOINT determinants at every trigger
+        #: (StreamTask.performCheckpoint:833-840).
+        self._source_flats = [
+            self.job.subtask_base(v.vertex_id) + s
+            for v in self.job.vertices if not self.job.in_edges(v.vertex_id)
+            for s in range(v.parallelism)]
+        #: recovery chunk size: larger than the live block trades a bigger
+        #: prewarm compile for fewer per-chunk dispatches on the failure
+        #: path (each costs ~2-10ms of tunnel latency).
+        self._recovery_ch = min(
+            recovery_block_steps or self.executor.block_steps,
+            self.executor.compiled.inflight_ring_steps,
+            self.executor.compiled.log_capacity // DETS_PER_STEP)
         if prewarm:
             self.prewarm_recovery()
 
@@ -190,7 +220,7 @@ class ClusterRunner:
         return f
 
     def _chunk(self) -> int:
-        return self.executor.block_steps
+        return self._recovery_ch
 
     def _fetch_fn(self):
         cap = self.executor.compiled.log_capacity
@@ -199,46 +229,103 @@ class ClusterRunner:
                 jax.tree_util.tree_map(lambda x: x[r], replicas),
                 from_epoch, cap)))
 
+    def _fetch_meta_fn(self, h: int):
+        """(count, start) of every holder's response in one device call —
+        holders are bit-identical replicas by construction, so the host
+        merge reduces to verifying the counts agree and pulling ONE body."""
+        cap = self.executor.compiled.log_capacity
+
+        def make():
+            def f(replicas, rs, from_epoch):
+                def one(r):
+                    rep_one = jax.tree_util.tree_map(
+                        lambda x: x[r], replicas)
+                    off = clog.epoch_start_offset(rep_one, from_epoch)
+                    cnt = jnp.clip(rep_one.head - off, 0, cap)
+                    return jnp.stack([cnt, off])
+                return jax.vmap(one)(rs)          # [h, 2]
+            return f
+        return self._jitted(("fetch_meta", h), make)
+
+    def _ring_bounds(self) -> Dict[int, Tuple[int, int]]:
+        """(tail, head) of every in-flight ring in ONE device read — ring
+        offsets don't move during recovery (write-backs change contents
+        only), so recover() reads them once instead of twice per chunk."""
+        if not self.executor.carry.out_rings:
+            return {}
+        fn = self._jitted(("ring_bounds",), lambda: (
+            lambda rings: jnp.stack(
+                [jnp.stack([el.tail, el.head]) for el in rings])))
+        arr = np.asarray(fn(self.executor.carry.out_rings))
+        return {ri: (int(arr[ri, 0]), int(arr[ri, 1]))
+                for ri in range(arr.shape[0])}
+
     def _ring_chunk_fn(self, ri: int, m: int):
         return self._jitted(("ring_chunk", ri, m), lambda: (
             lambda el, start: ifl.slice_steps(el, start, m)))
 
     def _route_chunk_fn(self, eidx: int, m: int):
-        """Route an [m, P_src, B] raw chunk over edge ``eidx`` and select
-        one destination subtask's lane: returns ([m, cap], total_count).
+        """Read + route one [m]-step window of edge ``eidx``'s producer
+        ring and select one destination subtask's lane — fused into one
+        program with the loop state (window start, rebalance offset,
+        remaining needed steps) carried ON DEVICE: per-chunk host scalars
+        would cost a ~8ms device_put each over the tunnel.
 
-        ``need`` masks steps >= need to invalid: a fixed-size chunk window
-        can extend past the replay range into steps the failed subtask
-        never consumed — those must replay as empty inputs (the
+        ``need_left`` masks steps past the replay range to invalid: a
+        fixed-size window can extend past the steps the failed subtask
+        ever consumed — those must replay as empty inputs (the
         replay-padding contract), not as the next epoch's records."""
+        def make():
+            body = self._route_body(eidx, m)
+
+            def f(el, start, sub, rr0, need_left):
+                raw, _cnt, _s0 = ifl.slice_steps(el, start, m)
+                routed_sub, cnt = body(raw, sub, rr0, need_left)
+                return (routed_sub, start + m, rr0 + cnt, need_left - m)
+            return f
+        return self._jitted(("route_chunk", eidx, m), make)
+
+    def _route_body(self, eidx: int, m: int):
+        """The shared exchange-replay body: mask steps past ``need_left``
+        invalid, route, select the destination subtask's lane."""
         e = self.job.edges[eidx]
         dst_p = self.job.vertices[e.dst].parallelism
         compiled = self.executor.compiled
 
+        def body(raw, sub, rr0, need_left):
+            need = jnp.clip(need_left, 0, m)
+            live = jnp.arange(m, dtype=jnp.int32) < need
+            raw = raw._replace(valid=raw.valid & live[:, None, None])
+            if eidx in compiled.static_route:
+                r, _ = compiled.static_route[eidx].apply(raw)
+            elif e.partition == PartitionType.HASH:
+                r, _ = routing.route_hash_block(
+                    raw, dst_p, self.job.num_key_groups, e.capacity)
+            elif e.partition == PartitionType.FORWARD:
+                r, _ = routing.route_forward_block(raw, e.capacity)
+            elif e.partition == PartitionType.REBALANCE:
+                counts = raw.count().sum(axis=1)
+                offs = rr0 + jnp.cumsum(counts) - counts
+                r, _ = routing.route_rebalance_block(
+                    raw, dst_p, e.capacity, offs)
+            else:
+                r, _ = routing.route_broadcast_block(raw, dst_p, e.capacity)
+            routed_sub = jax.tree_util.tree_map(lambda x: x[:, sub], r)
+            return routed_sub, raw.count().sum()
+        return body
+
+    def _route_raw_fn(self, eidx: int, m: int):
+        """Spill-path twin of :meth:`_route_chunk_fn`: routes a
+        host-assembled raw chunk instead of reading the device ring,
+        advancing the same device-carried loop state."""
         def make():
-            def f(raw, sub, rr0, need):
-                live = jnp.arange(m, dtype=jnp.int32) < need
-                raw = raw._replace(
-                    valid=raw.valid & live[:, None, None])
-                if eidx in compiled.static_route:
-                    r, _ = compiled.static_route[eidx].apply(raw)
-                elif e.partition == PartitionType.HASH:
-                    r, _ = routing.route_hash_block(
-                        raw, dst_p, self.job.num_key_groups, e.capacity)
-                elif e.partition == PartitionType.FORWARD:
-                    r, _ = routing.route_forward_block(raw, e.capacity)
-                elif e.partition == PartitionType.REBALANCE:
-                    counts = raw.count().sum(axis=1)
-                    offs = rr0 + jnp.cumsum(counts) - counts
-                    r, _ = routing.route_rebalance_block(
-                        raw, dst_p, e.capacity, offs)
-                else:
-                    r, _ = routing.route_broadcast_block(
-                        raw, dst_p, e.capacity)
-                routed_sub = jax.tree_util.tree_map(lambda x: x[:, sub], r)
-                return routed_sub, raw.count().sum()
+            body = self._route_body(eidx, m)
+
+            def f(raw, start, sub, rr0, need_left):
+                routed_sub, cnt = body(raw, sub, rr0, need_left)
+                return (routed_sub, start + m, rr0 + cnt, need_left - m)
             return f
-        return self._jitted(("route_chunk", eidx, m), make)
+        return self._jitted(("route_raw", eidx, m), make)
 
     def _replica_copy_fn(self):
         return self._jitted(("replica_copy",), lambda: (
@@ -253,6 +340,58 @@ class ClusterRunner:
             lambda buf_sub, routed: jax.tree_util.tree_map(
                 lambda a, b: jnp.concatenate([a, b], axis=0),
                 buf_sub, routed)))
+
+    # --- timers / epoch services ---------------------------------------------
+
+    def timer_service(self, flat_subtask: int):
+        """The per-task processing-time timer service (lazily created);
+        registered callbacks fire at block boundaries on causal time and
+        their TIMER_TRIGGER determinants replay after a failure."""
+        svc = self.timer_services.get(flat_subtask)
+        if svc is None:
+            from clonos_tpu.runtime.timers import ProcessingTimeService
+            svc = ProcessingTimeService(
+                append=lambda d, f=flat_subtask:
+                    self.executor.append_async_determinant(f, d))
+            self.timer_services[flat_subtask] = svc
+        return svc
+
+    def _advance_timers(self, now: int, stamp: int) -> None:
+        for flat, svc in self.timer_services.items():
+            if flat not in self.failed:
+                svc.advance(now, stamp)
+
+    @classmethod
+    def from_config(cls, job: JobGraph, config=None, **overrides
+                    ) -> "ClusterRunner":
+        """Build a runner from the typed Configuration surface
+        (config/defaults.py — the reference's flink-conf.yaml /
+        ExecutionConfig path). Explicit ``overrides`` win."""
+        from clonos_tpu.config import defaults as D
+        from clonos_tpu.config.options import Configuration
+        cfg = config or Configuration()
+        job.sharing_depth = cfg.get(D.DETERMINANT_SHARING_DEPTH)
+        kw: Dict[str, Any] = dict(
+            steps_per_epoch=cfg.get(D.CHECKPOINT_INTERVAL_STEPS),
+            num_standby=(cfg.get(D.NUM_STANDBY_TASKS)
+                         if cfg.get(D.FAILOVER_STRATEGY) == "standbytask"
+                         else 0),
+            heartbeat_timeout_s=cfg.get(D.HEARTBEAT_TIMEOUT_MS) / 1e3,
+            log_capacity=cfg.get(D.DETERMINANT_LOG_CAPACITY),
+            max_epochs=cfg.get(D.DETERMINANT_MAX_EPOCHS),
+            inflight_ring_steps=cfg.get(D.INFLIGHT_CAPACITY_BATCHES),
+        )
+        if cfg.get(D.INFLIGHT_TYPE) == "spillable":
+            kw["spool_dir"] = os.path.join(cfg.get(D.CHECKPOINT_DIR),
+                                           "spill")
+            kw["spill_policy"] = cfg.get(D.INFLIGHT_SPILL_POLICY)
+        if cfg.contains(D.CHECKPOINT_DIR):
+            kw["checkpoint_dir"] = cfg.get(D.CHECKPOINT_DIR)
+        kw.update(overrides)
+        runner = cls(job, **kw)
+        runner.coordinator.backoff_multiplier = cfg.get(
+            D.CHECKPOINT_BACKOFF_MULTIPLIER)
+        return runner
 
     # --- steady state --------------------------------------------------------
 
@@ -269,25 +408,46 @@ class ClusterRunner:
                 f"call recover() first")
         closed = self.executor.epoch_id
         n = self.executor.steps_per_epoch - self.executor.step_in_epoch
-        rc_before = int(np.sum(np.asarray(
-            self.executor.carry.record_counts)))
         self.executor.run_epoch()
         self.global_step += n
         self._fence_step[self.executor.epoch_id] = self.global_step
         self.heartbeats.beat_all_except(self.failed)
         self._m_steps.inc(n)
         self._m_epochs.inc()
-        self._m_records.mark(int(np.sum(np.asarray(
-            self.executor.carry.record_counts))) - rc_before)
+        # One fused device read per epoch: overflow flags + record total
+        # (the tunnel round-trip is the cost unit here, not device work).
+        vec = self.executor.health_vector()
+        total_records = int(vec[-1])
+        delta_records = total_records - self._last_records_total
+        self._m_records.mark(delta_records)
+        self._last_records_total = total_records
         # Overflow guards at every roll: an un-truncated ring that wrapped
         # has silently clobbered recovery state — fail loudly, never limp.
-        violations = self.executor.check_overflow()
+        violations = self.executor.overflow_messages(vec)
         if violations:
             raise OverflowError_("; ".join(violations))
+        # Host epoch control plane mirrors the fence.
+        self.epoch_tracker.inc_record_count(delta_records)
+        self.epoch_tracker.start_new_epoch(self.executor.epoch_id)
         # Checkpoint at the fence: the lean fence snapshot (op state +
         # offsets; logs/rings are truncated on completion, not persisted).
         self.coordinator.trigger(closed, self.executor.lean_snapshot(),
-                                 async_write=False)
+                                 async_write=False, owned=True)
+        # The checkpoint-trigger RPC arrival is nondeterministic in the
+        # reference and logged by every source
+        # (StreamTask.performCheckpoint:833-840); fence-aligned here, but
+        # the determinant is still recorded for replay/wire parity — one
+        # fused device append for all sources, AFTER the lean snapshot so
+        # the checkpointed log heads stay aligned with the fence offsets
+        # (the rows belong to the new epoch).
+        if self._source_flats:
+            t_ms = (self.executor.step_input_history[-1][0]
+                    if self.executor.step_input_history else 0)
+            self.executor.append_async_many(
+                self._source_flats,
+                det.SourceCheckpointDeterminant(
+                    record_count=self.executor.global_record_stamp(),
+                    checkpoint_id=closed, timestamp=t_ms))
         if complete_checkpoint:
             self.coordinator.ack_all(closed)
 
@@ -301,6 +461,46 @@ class ClusterRunner:
 
     # --- failure injection ---------------------------------------------------
 
+    def _inject_fn(self, vid: int):
+        """One fused kill program per vertex class (the eager per-array
+        zeroing cost ~10 full-carry copies per kill over the tunnel)."""
+        compiled = self.executor.compiled
+        nr = compiled.plan.num_replicas
+
+        def make():
+            def f(carry, sub, flat, held_idx):
+                fresh = clog.create(compiled.log_capacity,
+                                    compiled.max_epochs)
+                ops = list(carry.op_states)
+                ops[vid] = jax.tree_util.tree_map(
+                    lambda x: x.at[sub].set(jnp.zeros_like(x[sub])),
+                    ops[vid])
+                logs = jax.tree_util.tree_map(
+                    lambda s, fr: s.at[flat].set(fr), carry.logs, fresh)
+                replicas = carry.replicas
+                if nr > 0:
+                    replicas = jax.tree_util.tree_map(
+                        lambda s, fr: s.at[held_idx].set(
+                            jnp.broadcast_to(
+                                fr, held_idx.shape + fr.shape),
+                            mode="drop"),
+                        replicas, fresh)
+                rings = list(carry.out_rings)
+                if vid in compiled.ring_index:
+                    ri = compiled.ring_index[vid]
+                    el = rings[ri]
+                    rings[ri] = el._replace(
+                        keys=el.keys.at[:, sub].set(0),
+                        values=el.values.at[:, sub].set(0),
+                        timestamps=el.timestamps.at[:, sub].set(0),
+                        valid=el.valid.at[:, sub].set(False))
+                return carry._replace(
+                    op_states=tuple(ops), logs=logs, replicas=replicas,
+                    out_rings=tuple(rings),
+                    record_counts=carry.record_counts.at[flat].set(0))
+            return f
+        return self._jitted(("inject", vid), make)
+
     def inject_failure(self, flat_subtasks: Sequence[int]) -> None:
         """Kill subtasks: zero their device state — operator slice, causal
         log row, held replica rows, and their shard of the vertex's
@@ -308,41 +508,17 @@ class ClusterRunner:
         the producer). (Fault-injection API the reference delegates to
         Jepsen, flink-jepsen/.)"""
         carry = self.executor.carry
-        compiled = self.executor.compiled
+        nr = self.executor.compiled.plan.num_replicas
         for flat in flat_subtasks:
             self.failed.add(flat)
             self.heartbeats.mark_dead(flat)
             vid, sub = self._vertex_of(flat)
-            # Operator state slice -> zeros.
-            op = carry.op_states[vid]
-            op = jax.tree_util.tree_map(
-                lambda x: x.at[sub].set(jnp.zeros_like(x[sub])), op)
-            ops = list(carry.op_states)
-            ops[vid] = op
-            # Causal log row -> fresh.
-            fresh = clog.create(compiled.log_capacity, compiled.max_epochs)
-            logs = jax.tree_util.tree_map(
-                lambda s, f: s.at[flat].set(f), carry.logs, fresh)
-            # Replica rows held by the dead subtask -> fresh.
-            replicas = carry.replicas
-            for r in self.plan.replicas_held_by(flat):
-                replicas = jax.tree_util.tree_map(
-                    lambda s, f: s.at[r].set(f), replicas, fresh)
-            # The producer's in-flight ring shard -> zeros (content only;
-            # offsets are vertex-uniform and survive on the control plane).
-            rings = list(carry.out_rings)
-            if vid in compiled.ring_index:
-                ri = compiled.ring_index[vid]
-                el = rings[ri]
-                rings[ri] = el._replace(
-                    keys=el.keys.at[:, sub].set(0),
-                    values=el.values.at[:, sub].set(0),
-                    timestamps=el.timestamps.at[:, sub].set(0),
-                    valid=el.valid.at[:, sub].set(False))
-            carry = carry._replace(
-                op_states=tuple(ops), logs=logs, replicas=replicas,
-                out_rings=tuple(rings),
-                record_counts=carry.record_counts.at[flat].set(0))
+            held = np.full((max(nr, 1),), max(nr, 1), np.int32)
+            hl = self.plan.replicas_held_by(flat)
+            held[:len(hl)] = hl
+            carry = self._inject_fn(vid)(
+                carry, jnp.asarray(sub, jnp.int32),
+                jnp.asarray(flat, jnp.int32), jnp.asarray(held))
         self.executor.carry = carry
 
     def _vertex_of(self, flat: int) -> Tuple[int, int]:
@@ -377,6 +553,16 @@ class ClusterRunner:
         # dead tasks never acked; back off the checkpoint interval.
         ignored = tuple(self.coordinator.ignore_unacked_for(set(failed)))
         self.coordinator.backoff()
+        # Healthy tasks log the ignore decision (reference
+        # StreamTask.ignoreCheckpoint:891-915 — the RPC arrival is a
+        # determinant so their own later recoveries replay it).
+        healthy = [f for f in range(self.job.total_subtasks())
+                   if f not in self.failed]
+        for cid in ignored:
+            self.executor.append_async_many(
+                healthy, det.IgnoreCheckpointDeterminant(
+                    record_count=self.executor.global_record_stamp(),
+                    checkpoint_id=cid))
 
         ckpt = self.standbys.latest
         from_epoch = ckpt.checkpoint_id + 1
@@ -394,6 +580,7 @@ class ClusterRunner:
             return now
 
         patched = self.executor.carry
+        self._bounds_cache = self._ring_bounds()
         tp = _clock("restore", t0)
 
         for flat in failed:
@@ -431,14 +618,34 @@ class ClusterRunner:
                 # the same boundary: sink exactly-once needs transactional
                 # sinks, TwoPhaseCommitSinkFunction.)
                 synthesized = True
-            mgr.expect_determinant_responses(len(holders))
-            fetch = self._fetch_fn()
-            for r, _h in holders:
-                buf, count, start = fetch(
-                    patched.replicas, jnp.asarray(r, jnp.int32),
-                    jnp.asarray(from_epoch, jnp.int32))
-                mgr.notify_determinant_response(
-                    np.asarray(buf)[: int(count)], int(start))
+            r_best = None
+            if holders:
+                # One device call for every holder's (count, start); the
+                # holders are bit-identical replicas by construction, so
+                # when their metadata agrees the merge is "pull one body"
+                # (saves H-1 multi-MB transfers + 2(H-1) round-trips).
+                hidx = jnp.asarray([r for r, _ in holders], jnp.int32)
+                meta = np.asarray(self._fetch_meta_fn(len(holders))(
+                    patched.replicas, hidx,
+                    jnp.asarray(from_epoch, jnp.int32)))
+                consistent = (len(np.unique(meta[:, 0])) == 1
+                              and len(np.unique(meta[:, 1])) == 1)
+                use = ([holders[0]] if consistent else holders)
+                mgr.expect_determinant_responses(len(use))
+                fetch = self._fetch_fn()
+                for j, (r, _h) in enumerate(use):
+                    buf, count, start = fetch(
+                        patched.replicas, jnp.asarray(r, jnp.int32),
+                        jnp.asarray(from_epoch, jnp.int32))
+                    mgr.notify_determinant_response(
+                        np.asarray(buf)[: int(meta[j, 0])],
+                        int(meta[j, 1]))
+                # A single consistent replica's device bytes can restore
+                # the log directly; disagreeing holders must go through
+                # the host merge (r_best None -> chunked upload path).
+                r_best = holders[0][0] if consistent else None
+            else:
+                mgr.expect_determinant_responses(0)
             if synthesized:
                 rows = self._synthesize_det_rows(fence, n_steps)
                 start = int(np.asarray(snap.log_heads[flat]))
@@ -478,6 +685,14 @@ class ClusterRunner:
                 n_steps=n_steps, verify_outputs=not synthesized)
             result = mgr.run_replay(plan)
             total_records += result.records_replayed
+            # Re-fire recovered timer effects (rows are already spliced
+            # into the rebuilt log; only the callback side-effects re-run —
+            # reference LogReplayerImpl.triggerAsyncEvent:102).
+            svc = self.timer_services.get(flat)
+            if svc is not None:
+                for _step_i, ad in result.async_events:
+                    if isinstance(ad, det.TimerTriggerDeterminant):
+                        svc.refire(ad)
             tp = _clock("replay", tp)
 
             rebuilt = np.asarray(result.rebuilt_log_rows)
@@ -490,7 +705,8 @@ class ClusterRunner:
                     f"from the recovered log")
 
             patched = self._patch(patched, snap, vid, sub, flat,
-                                  result, rebuilt, from_epoch, fence, n_steps)
+                                  result, rebuilt, from_epoch, fence,
+                                  n_steps, replica_src=r_best)
             tp = _clock("patch", tp)
 
         # Replica rows held by revived subtasks: replicas are identical to
@@ -515,7 +731,9 @@ class ClusterRunner:
                 jnp.asarray(rs_p), jnp.asarray(os_p)))
 
         self.executor.carry = patched
-        jax.block_until_ready(patched)
+        self._bounds_cache = None
+        from clonos_tpu.utils.devsync import device_sync
+        device_sync(patched)
         tp = _clock("replica_rebuild", tp)
         for flat in failed:
             self.heartbeats.revive(flat)
@@ -533,8 +751,8 @@ class ClusterRunner:
         self._m_recovered_records.inc(report.records_replayed)
         return report
 
-    def prewarm_recovery(self, vertex_ids: Optional[Sequence[int]] = None
-                         ) -> float:
+    def prewarm_recovery(self, vertex_ids: Optional[Sequence[int]] = None,
+                         spill_paths: bool = False) -> float:
         """Compile every recovery program a standby will need, at job
         start — the reference keeps standby tasks *deployed* so failover
         only switches them to RUNNING (Task.java:300-302, :1040,
@@ -564,14 +782,28 @@ class ClusterRunner:
             return RB(zero(lead), zero(lead), zero(lead),
                       zero(lead, jnp.bool_))
 
-        # Fetch + replica copy.
+        # Fetch + replica copy + ring bounds + replica-sourced log restore.
         if compiled.plan.num_replicas > 0:
             self._fetch_fn()(carry.replicas, jnp.asarray(0, jnp.int32),
                              jnp.asarray(0, jnp.int32))
+            holders_per_owner = {}
+            for (o, _h) in compiled.plan.pairs:
+                holders_per_owner[o] = holders_per_owner.get(o, 0) + 1
+            for h in set(holders_per_owner.values()):
+                self._fetch_meta_fn(h)(carry.replicas, zero((h,)),
+                                       jnp.asarray(0, jnp.int32))
+            self._log_restore_from_replica_fn()(
+                carry.replicas, jnp.asarray(0, jnp.int32),
+                jnp.asarray(0, jnp.int32), jnp.asarray(0, jnp.int32),
+                jnp.asarray(0, jnp.int32), zero((compiled.max_epochs,)),
+                zero((compiled.max_epochs,), jnp.bool_),
+                jnp.asarray(0, jnp.int32), jnp.asarray(0, jnp.int32))
             nr = compiled.plan.num_replicas
             self._replica_copy_fn()(
                 carry.replicas, carry.logs,
                 jnp.full((nr,), nr, jnp.int32), zero((nr,)))
+        if carry.out_rings:
+            self._ring_bounds()
         # Shared log-restore programs.
         st = clog.create(compiled.log_capacity, compiled.max_epochs)
         st = self._log_restore_fn()(
@@ -598,10 +830,15 @@ class ClusterRunner:
                     if m <= 0:
                         continue
                     self._ring_chunk_fn(ri, m)(el, jnp.asarray(0, jnp.int32))
-                    self._route_chunk_fn(eidx, m)(
-                        zero_batch((m, src_p, src_cap)),
-                        jnp.asarray(0, jnp.int32), jnp.asarray(0, jnp.int32),
-                        jnp.asarray(0, jnp.int32))
+                    z = jnp.asarray(0, jnp.int32)
+                    self._route_chunk_fn(eidx, m)(el, z, z, z, z)
+                    if spill_paths:
+                        # Spill-path twin (AVAILABILITY wrap recovery):
+                        # doubles the exchange compiles, so opt-in — a
+                        # ring-covered recovery (the common case) never
+                        # takes this path.
+                        self._route_raw_fn(eidx, m)(
+                            zero_batch((m, src_p, src_cap)), z, z, z, z)
                 self._first_chunk_fn(eidx)(
                     zero_batch((1, e.capacity)),
                     zero_batch((ch - 1, e.capacity)))
@@ -621,17 +858,22 @@ class ClusterRunner:
                 rp = self._make_replayer(vid, sub)
                 rp._jit_block(state0, chunk0, zero((ch,)), zero((ch,)),
                               jnp.asarray(sub, jnp.int32))
-            # Graft + ring write.
+                rp._jit_tslice(zero((ch,)), jnp.asarray(0, jnp.int32))
+            # Graft + kill + ring write.
             self._graft_fn(vid)(
                 carry, state0, st, jnp.asarray(0, jnp.int32),
                 jnp.asarray(0, jnp.int32), jnp.asarray(0, jnp.int32))
+            nrp = max(compiled.plan.num_replicas, 1)
+            self._inject_fn(vid)(
+                carry, jnp.asarray(0, jnp.int32), jnp.asarray(0, jnp.int32),
+                jnp.full((nrp,), nrp, jnp.int32))
             if vid in compiled.ring_index:
                 ri = compiled.ring_index[vid]
                 out_cap = compiled.vertex_out_capacity(vid)
+                z = jnp.asarray(0, jnp.int32)
                 self._ring_write_fn(ri, ch)(
                     carry.out_rings[ri], zero_batch((ch, out_cap)),
-                    jnp.asarray(0, jnp.int32), jnp.asarray(0, jnp.int32),
-                    jnp.asarray(1, jnp.int32), jnp.asarray(0, jnp.int32))
+                    z, z, jnp.asarray(1, jnp.int32), z)
         return _time.monotonic() - t0
 
     # --- input reconstruction ------------------------------------------------
@@ -651,16 +893,23 @@ class ClusterRunner:
         compiled = self.executor.compiled
         ri = compiled.ring_index[src_vid]
         el = patched.out_rings[ri]
-        batch, cnt, s0 = self._ring_chunk_fn(ri, n)(
-            el, jnp.asarray(start, jnp.int32))
-        got_start = int(s0)
+        # Coverage math from the bounds cache (one read per recover();
+        # ring offsets are stable across recovery — write-backs replace
+        # contents only), so the fast path costs zero host round-trips.
+        if getattr(self, "_bounds_cache", None) and ri in self._bounds_cache:
+            tail, head = self._bounds_cache[ri]
+        else:
+            tail, head = int(el.tail), int(el.head)
+        got_start = max(start, tail)
+        cnt = max(min(head - got_start, n), 0)
         # Steps physically retained by the ring: slice_steps only clamps to
         # ``tail``, but when checkpoints stall past ring capacity newer
         # appends have clobbered positions of steps < head - ring_steps —
         # those must come from the spill even though tail hasn't advanced.
-        ring_lo = max(int(el.tail), int(el.head) - el.ring_steps)
-        if got_start == start and start >= ring_lo \
-                and int(cnt) >= need:
+        ring_lo = max(tail, head - el.ring_steps)
+        batch, _, _ = self._ring_chunk_fn(ri, n)(
+            el, jnp.asarray(start, jnp.int32))
+        if got_start == start and start >= ring_lo and cnt >= need:
             return batch
         # Ring shortfall: pull the missing leading steps from the spill.
         if self.executor.spill_logs is None:
@@ -672,17 +921,25 @@ class ClusterRunner:
         required_end = min(start + need, boundary)
         parts = []
         have = start
-        for ep in spill.retained_epochs():
-            ep_start, ep_batch = spill.load_epoch(ep)
-            ep_n = ep_batch.keys.shape[0]
-            lo = max(have, ep_start)
-            hi = min(ep_start + ep_n, boundary)
-            if hi > lo:
-                parts.append(jax.tree_util.tree_map(
-                    lambda x: x[lo - ep_start: hi - ep_start], ep_batch))
-                have = hi
-            if have >= boundary:
-                break
+        # Prefetching epoch reads (reference SpilledReplayIterator.java:61
+        # — async reads run ahead of consumption).
+        eps = spill.retained_epochs()
+        if eps:
+            it = ifl.ReplayIterator(spill, eps[0], eps[-1])
+            try:
+                for ep_start, ep_batch in it.epochs():
+                    ep_n = ep_batch.keys.shape[0]
+                    lo = max(have, ep_start)
+                    hi = min(ep_start + ep_n, boundary)
+                    if hi > lo:
+                        parts.append(jax.tree_util.tree_map(
+                            lambda x: x[lo - ep_start: hi - ep_start],
+                            ep_batch))
+                        have = hi
+                    if have >= boundary:
+                        break
+            finally:
+                it.close()
         if have < required_end:
             raise rec.RecoveryError(
                 f"vertex {src_vid}: spill does not cover steps "
@@ -717,40 +974,49 @@ class ClusterRunner:
         prewarm-compiled — recovery pays no XLA compile (warm standby)."""
         e = self.job.edges[eidx]
         ch = self._chunk()
+        compiled = self.executor.compiled
+        ri = compiled.ring_index[e.src]
         first = jax.tree_util.tree_map(
             lambda x: x[sub][None], snap.edge_bufs[eidx])
         if n_steps <= 0:
             return []
-        sub_j = jnp.asarray(sub, jnp.int32)
-        rr0 = jnp.asarray(snap.rr_offsets[eidx][0], jnp.int32)
+        el = patched.out_rings[ri]
+        if self._bounds_cache and ri in self._bounds_cache:
+            tail, head = self._bounds_cache[ri]
+        else:
+            tail, head = int(el.tail), int(el.head)
+        ring_lo = max(tail, head - el.ring_steps)
+        # Loop state lives ON DEVICE (a host scalar put per chunk costs a
+        # tunnel round-trip); coverage decisions use the host bounds.
+        start_d = jnp.asarray(fence, jnp.int32)
+        sub_d = jnp.asarray(sub, jnp.int32)
+        rr_d = jnp.asarray(snap.rr_offsets[eidx][0], jnp.int32)
+        need_d = jnp.asarray(n_steps - 1, jnp.int32)
         chunks = []
         nblocks = -(-n_steps // ch)
         for i in range(nblocks):
-            hi = min(n_steps, (i + 1) * ch)
-            if i == 0:
-                # Replay block 0 consumes [edge_buf, routed(fence ..
-                # fence+ch-1)].
-                m = ch - 1
-                need = min(n_steps - 1, m)
-                if m > 0:
-                    raw = self._ring_steps(patched, e.src, fence, m,
-                                           need=need)
-                    routed, cnt = self._route_chunk_fn(eidx, m)(
-                        raw, sub_j, rr0, jnp.asarray(need, jnp.int32))
-                    rr0 = rr0 + cnt
-                    chunk = self._first_chunk_fn(eidx)(first, routed)
-                else:
-                    chunk = first
+            m = ch - 1 if i == 0 else ch
+            h_start = fence if i == 0 else fence + i * ch - 1
+            h_need = (min(n_steps - 1, m) if i == 0
+                      else min(n_steps, (i + 1) * ch) - i * ch)
+            if m == 0:
+                chunks.append(first)
+                continue
+            covered = (h_start >= ring_lo and h_start >= tail
+                       and head - h_start >= h_need)
+            if covered:
+                routed, start_d, rr_d, need_d = self._route_chunk_fn(
+                    eidx, m)(el, start_d, sub_d, rr_d, need_d)
             else:
-                need = hi - i * ch
-                raw = self._ring_steps(patched, e.src,
-                                       fence + i * ch - 1, ch,
-                                       need=need)
-                routed, cnt = self._route_chunk_fn(eidx, ch)(
-                    raw, sub_j, rr0, jnp.asarray(need, jnp.int32))
-                rr0 = rr0 + cnt
-                chunk = routed
-            chunks.append(chunk)
+                # Spill path (ring shortfall): host-assembled raw chunk.
+                raw = self._ring_steps(patched, e.src, h_start, m,
+                                       need=h_need)
+                routed, start_d, rr_d, need_d = self._route_raw_fn(
+                    eidx, m)(raw, start_d, sub_d, rr_d, need_d)
+            if i == 0:
+                chunks.append(self._first_chunk_fn(eidx)(first, routed))
+            else:
+                chunks.append(routed)
         return chunks
 
     def _reread_feed(self, vid: int, sub: int, snap: LeanSnapshot,
@@ -820,7 +1086,7 @@ class ClusterRunner:
         slot_keys = self.executor.compiled.consumer_slot_keys(vid)
         return rec.LogReplayer(
             v.operator, v.parallelism,
-            block_steps=self.executor.block_steps,
+            block_steps=self._recovery_ch,
             in_slot_keys=(slot_keys[sub:sub + 1]
                           if slot_keys is not None else None))
 
@@ -832,6 +1098,31 @@ class ClusterRunner:
                 return clog.append(state, rows_chunk, count)
             return f
         return self._jitted(("log_append",), make)
+
+    def _log_restore_from_replica_fn(self):
+        """Rebuild a failed task's log row ON DEVICE from a surviving
+        replica: the replayed determinant stream was verified equal to the
+        recovered one, so the replica's bytes ARE the restored log — no
+        host round-trip of the rows."""
+        cap = self.executor.compiled.log_capacity
+        me = self.executor.compiled.max_epochs
+
+        def make():
+            def f(replicas, r, from_epoch, used, ck_head,
+                  epoch_offs, epoch_mask, latest, base):
+                rep_one = jax.tree_util.tree_map(lambda x: x[r], replicas)
+                buf, _cnt, _start = clog.get_determinants(
+                    rep_one, from_epoch, cap)
+                st = clog.create(cap, me)
+                st = st._replace(head=ck_head, tail=ck_head)
+                st = clog.append(st, buf, used)
+                return st._replace(
+                    epoch_starts=jnp.where(epoch_mask, epoch_offs,
+                                           st.epoch_starts),
+                    latest_epoch=jnp.maximum(st.latest_epoch, latest),
+                    epoch_base=jnp.maximum(st.epoch_base, base))
+            return f
+        return self._jitted(("log_restore_replica",), make)
 
     def _log_finalize_fn(self):
         def make():
@@ -863,7 +1154,8 @@ class ClusterRunner:
 
     def _ring_write_fn(self, ri: int, m: int):
         """Write an [m, cap] replayed output chunk into ring ``ri`` at
-        steps [base, base+m), keeping only steps in [keep_from, hi)."""
+        steps [base, base+m), keeping only steps in [keep_from, hi);
+        returns (ring, base + m) so the loop cursor stays on device."""
         def make():
             def f(el, chunk, base, sub, keep_from, hi):
                 steps = base + jnp.arange(m, dtype=jnp.int32)
@@ -877,35 +1169,22 @@ class ClusterRunner:
                     timestamps=el.timestamps.at[pos, sub].set(
                         chunk.timestamps, mode="drop"),
                     valid=el.valid.at[pos, sub].set(chunk.valid,
-                                                    mode="drop"))
+                                                    mode="drop")), base + m
             return f
         return self._jitted(("ring_write", ri, m), make)
 
     def _patch(self, carry: JobCarry, snap: LeanSnapshot, vid: int,
                sub: int, flat: int, result: rec.ReplayResult,
                det_rows: np.ndarray, from_epoch: int, fence: int,
-               n_steps: int) -> JobCarry:
+               n_steps: int, replica_src: Optional[int] = None
+               ) -> JobCarry:
         """Graft the rebuilt subtask back into the live carry. Every
         device program here is fixed-shape (chunked appends/writes) so a
         prewarmed standby pays zero XLA compile on the failure path."""
         compiled = self.executor.compiled
         ch4 = self._chunk() * DETS_PER_STEP
-        # Causal log row: an empty log re-based at the fence offset (the
-        # pre-fence rows were truncated by the completed checkpoint — the
-        # lean snapshot deliberately doesn't carry them) + recovered rows,
-        # appended in fixed-size chunks.
         ck_head = int(np.asarray(snap.log_heads[flat]))
-        restored = clog.create(compiled.log_capacity, compiled.max_epochs)
-        base = jnp.asarray(ck_head, jnp.int32)
-        restored = restored._replace(head=base, tail=base)
         n = det_rows.shape[0]
-        app = self._log_restore_fn()
-        for lo in range(0, n, ch4):
-            cnt = min(ch4, n - lo)
-            chunk = np.zeros((ch4, det.NUM_LANES), np.int32)
-            chunk[:cnt] = det_rows[lo:lo + cnt]
-            restored = app(jnp.asarray(chunk),
-                           jnp.asarray(cnt, jnp.int32), restored)
         # Epoch->offset index entries died with the task; rebuild them from
         # the fence-step ledger. Sync blocks anchor at TIMESTAMP rows.
         ts_pos = (np.where((det_rows[:, det.LANE_TAG] == det.TIMESTAMP)
@@ -932,10 +1211,33 @@ class ClusterRunner:
                 epoch_offs[e % me] = off
                 epoch_mask[e % me] = True
                 latest = max(latest, e)
-        restored = self._log_finalize_fn()(
-            restored, jnp.asarray(epoch_offs), jnp.asarray(epoch_mask),
-            jnp.asarray(latest, jnp.int32),
-            jnp.asarray(from_epoch, jnp.int32))
+        if replica_src is not None:
+            # The replayed stream was verified equal to the recovered one,
+            # so the replica's device bytes ARE the restored log (no h2d).
+            restored = self._log_restore_from_replica_fn()(
+                carry.replicas, jnp.asarray(replica_src, jnp.int32),
+                jnp.asarray(from_epoch, jnp.int32),
+                jnp.asarray(n, jnp.int32), jnp.asarray(ck_head, jnp.int32),
+                jnp.asarray(epoch_offs), jnp.asarray(epoch_mask),
+                jnp.asarray(latest, jnp.int32),
+                jnp.asarray(from_epoch, jnp.int32))
+        else:
+            # Synthesized streams (sink recovery) upload in fixed chunks.
+            restored = clog.create(compiled.log_capacity,
+                                   compiled.max_epochs)
+            base = jnp.asarray(ck_head, jnp.int32)
+            restored = restored._replace(head=base, tail=base)
+            app = self._log_restore_fn()
+            for lo in range(0, n, ch4):
+                cnt = min(ch4, n - lo)
+                chunk = np.zeros((ch4, det.NUM_LANES), np.int32)
+                chunk[:cnt] = det_rows[lo:lo + cnt]
+                restored = app(jnp.asarray(chunk),
+                               jnp.asarray(cnt, jnp.int32), restored)
+            restored = self._log_finalize_fn()(
+                restored, jnp.asarray(epoch_offs), jnp.asarray(epoch_mask),
+                jnp.asarray(latest, jnp.int32),
+                jnp.asarray(from_epoch, jnp.int32))
         # Operator state slice + log row + record count in one program.
         rc = snap.record_counts[flat] + result.records_replayed
         carry = self._graft_fn(vid)(
@@ -959,14 +1261,16 @@ class ClusterRunner:
             hi = jnp.asarray(fence + n_steps, jnp.int32)
             sub_j = jnp.asarray(sub, jnp.int32)
             ch = self._chunk()
+            base_d = None
             for i, chunk in enumerate(result.out_chunks):
                 m = chunk.keys.shape[0]
                 base_i = fence + i * ch
                 if base_i + m <= fence + n_steps - min(n_steps,
                                                        el.ring_steps):
                     continue      # wholly before the retained window
-                el = self._ring_write_fn(ri, m)(
-                    el, chunk, jnp.asarray(base_i, jnp.int32), sub_j,
-                    keep_from, hi)
+                if base_d is None:
+                    base_d = jnp.asarray(base_i, jnp.int32)
+                el, base_d = self._ring_write_fn(ri, m)(
+                    el, chunk, base_d, sub_j, keep_from, hi)
             rings[ri] = el
         return carry._replace(out_rings=tuple(rings))

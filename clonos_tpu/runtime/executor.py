@@ -44,7 +44,8 @@ from __future__ import annotations
 import dataclasses
 import time as _time
 from functools import partial
-from typing import Any, Dict, List, NamedTuple, Optional, Tuple
+from typing import (Any, Dict, List, NamedTuple, Optional, Sequence,
+                    Tuple)
 
 import jax
 import jax.numpy as jnp
@@ -526,7 +527,15 @@ class LocalExecutor:
         self._rng = np.random.RandomState(seed)
         self.epoch_id = 0
         self.step_in_epoch = 0
-        self._jit_block = jax.jit(self.compiled.run_block)
+        #: supersteps actually executed (the staged epoch path pre-fills
+        #: step_input_history, so len(history) over-counts mid-epoch).
+        self._steps_executed = 0
+        # The carry is donated: the block program updates GB-scale log /
+        # ring storage in place instead of copying it every call (the
+        # carry's buffers are only ever referenced by the live executor;
+        # checkpoints deep-copy what they keep — lean_snapshot).
+        self._jit_block = jax.jit(self.compiled.run_block,
+                                  donate_argnums=0)
 
         plan = self.compiled.plan
 
@@ -560,21 +569,80 @@ class LocalExecutor:
                                 for el in carry.out_rings),
                 replicas=replicas)
 
-        self._jit_roll = jax.jit(_roll)
-        self._jit_trunc = jax.jit(_trunc)
+        self._jit_roll = jax.jit(_roll, donate_argnums=0)
+        self._jit_trunc = jax.jit(_trunc, donate_argnums=0)
         # Host-side spill owners, one per ring vertex (None = disabled).
         self.spill_policy = spill_policy
         self.spill_logs: Optional[List[ifl.SpillingInFlightLog]] = None
+        #: per-ring epochs deferred by the AVAILABILITY policy, awaiting
+        #: either a later spill (before a wrap) or truncation.
+        self._pending_spill: List[List[Tuple[int, int, int]]] = [
+            [] for _ in self.compiled.ring_vertices]
         if spool_dir is not None:
             self.spill_logs = [
                 ifl.SpillingInFlightLog(spool_dir, edge_id=vid,
                                         policy=spill_policy)
                 for vid in self.compiled.ring_vertices]
+        # Anti-alias the initial carry: constructors (and XLA CSE inside
+        # jitted init paths) can hand several leaves the same underlying
+        # buffer, which the donated block program rejects ("donate the
+        # same buffer twice"). An eager copy per leaf guarantees distinct
+        # buffers once; later programs keep them distinct (outputs alias
+        # donated inputs one-to-one).
+        self.carry = jax.tree_util.tree_map(
+            lambda x: jnp.asarray(x).copy(), self.carry)
         # Epoch 0 starts at log offset 0 for every log.
         self.carry = self._jit_roll(self.carry, 0)
         self.step_input_history: List[Tuple[int, int]] = []
         #: vid -> FeedReader for HostFeedSource vertices
         self.feed_readers: Dict[int, Any] = {}
+        #: called after every block with (last_causal_time, record_stamp) —
+        #: the superstep-boundary hook timer services advance on.
+        self.block_listeners: List[Any] = []
+
+        owner_idx = self.compiled._owner_idx
+        nrep = self.compiled.plan.num_replicas
+
+        def _append_many(log_rows, log_heads, rep_rows, rep_heads,
+                         rows1, counts):
+            # Masked single-row append per selected log + its replicas,
+            # donated in-place (rows storage is referenced only by the
+            # live carry; heads are returned fresh because lean snapshots
+            # alias them).
+            L = log_heads.shape[0]
+            capm = self.compiled.log_capacity - 1
+            pos = log_heads & capm
+            cur = log_rows[jnp.arange(L), pos]
+            sel = counts[:, None] > 0
+            log_rows = log_rows.at[jnp.arange(L), pos].set(
+                jnp.where(sel, rows1, cur))
+            log_heads = log_heads + counts
+            if nrep > 0:
+                rrows1 = rows1[owner_idx]
+                rcounts = counts[owner_idx]
+                rpos = rep_heads & capm
+                rcur = rep_rows[jnp.arange(nrep), rpos]
+                rsel = rcounts[:, None] > 0
+                rep_rows = rep_rows.at[jnp.arange(nrep), rpos].set(
+                    jnp.where(rsel, rrows1, rcur))
+                rep_heads = rep_heads + rcounts
+            return log_rows, log_heads, rep_rows, rep_heads
+
+        self._jit_append_many = jax.jit(_append_many,
+                                        donate_argnums=(0, 2))
+
+        bs = self.block_steps
+
+        def _stage_block(t_all, r_all, lo, epoch, g0):
+            # One-dispatch staging of a block's inputs from the epoch-wide
+            # uploaded time/rng streams; the cursor stays on device (a
+            # host scalar put per block costs ~8ms of tunnel latency).
+            return BlockInputs(
+                times=jax.lax.dynamic_slice(t_all, (lo,), (bs,)),
+                rng_bits=jax.lax.dynamic_slice(r_all, (lo,), (bs,)),
+                epoch=epoch, step0=g0 + lo, feeds=()), lo + bs
+
+        self._jit_stage_block = jax.jit(_stage_block)
 
     def register_feed(self, vertex_id: int, reader) -> None:
         """Attach a rewindable reader (api/feeds.py) to a HostFeedSource
@@ -624,11 +692,22 @@ class LocalExecutor:
             step0=jnp.asarray(len(self.step_input_history) - k, jnp.int32),
             feeds=self._pull_feeds(k))
 
+    def _notify_block(self) -> None:
+        # Uses the last EXECUTED step's time/stamp — the staged epoch path
+        # pre-fills step_input_history, so [-1] would be the epoch end.
+        if self.block_listeners and self._steps_executed:
+            t = self.step_input_history[self._steps_executed - 1][0]
+            stamp = self.global_record_stamp()
+            for fn in self.block_listeners:
+                fn(t, stamp)
+
     def step(self) -> StepOutputs:
         """Run one superstep on the live path (a K=1 block)."""
         self.carry, outs = self._jit_block(self.carry,
                                            self._next_block_inputs(1))
         self.step_in_epoch += 1
+        self._steps_executed += 1
+        self._notify_block()
         return StepOutputs(
             sinks={vid: jax.tree_util.tree_map(lambda x: x[0], b)
                    for vid, b in outs.sinks.items()},
@@ -639,12 +718,41 @@ class LocalExecutor:
         """Run the remainder of the current epoch in block programs, then
         roll the epoch (the checkpoint fence lands here)."""
         outs = None
+        remaining = self.steps_per_epoch - self.step_in_epoch
+        full_blocks = remaining // self.block_steps
+        if full_blocks > 1 and not self.compiled.feed_vertices:
+            # Stage the full blocks' causal inputs in ONE upload and carry
+            # the block cursor on device — per-block transfers cost a
+            # tunnel round-trip each.
+            n = full_blocks * self.block_steps
+            g0 = len(self.step_input_history)
+            times = np.empty((n,), np.int32)
+            rngs = np.empty((n,), np.int32)
+            for i in range(n):
+                t = self.time_source.now()
+                r = int(self._rng.randint(0, 2 ** 31, dtype=np.int64))
+                times[i], rngs[i] = t, r
+                self.step_input_history.append((t, r))
+            t_all = jnp.asarray(times)
+            r_all = jnp.asarray(rngs)
+            lo = jnp.asarray(0, jnp.int32)
+            epoch = jnp.asarray(self.epoch_id, jnp.int32)
+            g0_d = jnp.asarray(g0, jnp.int32)
+            for _ in range(full_blocks):
+                bi, lo = self._jit_stage_block(t_all, r_all, lo, epoch,
+                                               g0_d)
+                self.carry, outs = self._jit_block(self.carry, bi)
+                self.step_in_epoch += self.block_steps
+                self._steps_executed += self.block_steps
+                self._notify_block()
         while self.step_in_epoch < self.steps_per_epoch:
             k = min(self.block_steps,
                     self.steps_per_epoch - self.step_in_epoch)
             self.carry, outs = self._jit_block(self.carry,
                                                self._next_block_inputs(k))
             self.step_in_epoch += k
+            self._steps_executed += k
+            self._notify_block()
         closed = self.epoch_id
         self.epoch_id += 1
         self.step_in_epoch = 0
@@ -657,19 +765,44 @@ class LocalExecutor:
         """Move the just-closed epoch's in-flight batches to the host spill
         owner (reference SpillableSubpartitionInFlightLogger writes one file
         per epoch as it closes). Policy AVAILABILITY skips epochs while the
-        ring has headroom (reference spill.policy availability)."""
+        ring has headroom (reference spill.policy availability) — but a
+        skipped epoch is only DEFERRED: before a future ring wrap could
+        clobber its steps, it is retroactively spilled (the round-2/3
+        advice hole: 'skip forever' silently destroys the only copy and
+        recovery fails only at recovery time)."""
         for i, el in enumerate(self.carry.out_rings):
+            start = int(ifl.epoch_start_step(el, epoch))
+            head = int(el.head)
+            n = head - start
+            skip = False
             if self.spill_policy == ifl.SpillPolicy.AVAILABILITY:
                 occupancy = float(jnp.asarray(ifl.size(el))) / el.ring_steps
                 if occupancy < self.spill_logs[i].availability_trigger:
-                    continue
-            start = int(ifl.epoch_start_step(el, epoch))
-            n = int(el.head) - start
-            if n <= 0:
-                continue
-            batch, count, s0 = ifl.slice_steps(el, start, n)
-            self.spill_logs[i].spill_epoch(epoch, int(s0),
-                                           jax.device_get(batch))
+                    skip = True
+            if skip:
+                if n > 0:
+                    self._pending_spill[i].append((epoch, start, n))
+            elif n > 0:
+                batch, count, s0 = ifl.slice_steps(el, start, n)
+                self.spill_logs[i].spill_epoch(epoch, int(s0),
+                                               jax.device_get(batch))
+            # Retroactive flush: anything a wrap could reach within the
+            # next epoch's appends must leave the ring now.
+            danger = head + self.steps_per_epoch - el.ring_steps
+            keep = []
+            for (e, s, m) in self._pending_spill[i]:
+                if s < head - el.ring_steps:
+                    raise RuntimeError(
+                        f"in-flight ring {i}: epoch {e} steps "
+                        f"[{s}, {s + m}) were clobbered before spilling "
+                        f"(AVAILABILITY policy deferred too long)")
+                if s < danger:
+                    batch, count, s0 = ifl.slice_steps(el, s, m)
+                    self.spill_logs[i].spill_epoch(e, int(s0),
+                                                   jax.device_get(batch))
+                else:
+                    keep.append((e, s, m))
+            self._pending_spill[i] = keep
 
     def notify_checkpoint_complete(self, epoch: int) -> None:
         """Truncate determinant + in-flight logs for epochs <= ``epoch``."""
@@ -677,33 +810,66 @@ class LocalExecutor:
         if self.spill_logs is not None:
             for sl in self.spill_logs:
                 sl.truncate(epoch)
+        for i, pend in enumerate(self._pending_spill):
+            self._pending_spill[i] = [(e, s, m) for (e, s, m) in pend
+                                      if e > epoch]
+
+    def _health_vector(self, carry: JobCarry) -> jnp.ndarray:
+        """Pure: packed int32 [3 + num_rings + 1 + 1] health flags + total
+        record count — ONE device value so the per-epoch control-plane
+        read costs one host round-trip, not six (the tunnel RTT is the
+        per-epoch overhead, not the device work)."""
+        logs = carry.logs
+        cap = self.compiled.log_capacity
+        flags = [
+            jnp.any(logs.head - logs.tail > cap),
+            jnp.any(logs.latest_epoch - logs.epoch_base + 1
+                    > self.compiled.max_epochs),
+            jnp.any(clog.near_offset_wrap(logs)),
+        ]
+        for el in carry.out_rings:
+            flags.append(jnp.asarray(ifl.overflowed(el)))
+        if self.compiled.plan.num_replicas > 0:
+            flags.append(jnp.any(carry.replicas.head - carry.replicas.tail
+                                 > cap))
+        else:
+            flags.append(jnp.zeros((), jnp.bool_))
+        vec = jnp.stack([f.astype(jnp.int32) for f in flags])
+        return jnp.concatenate(
+            [vec, carry.record_counts.sum()[None]])
+
+    def health_vector(self) -> np.ndarray:
+        if not hasattr(self, "_jit_health"):
+            self._jit_health = jax.jit(self._health_vector)
+        return np.asarray(self._jit_health(self.carry))
+
+    def overflow_messages(self, vec: np.ndarray) -> List[str]:
+        """Decode :meth:`health_vector` flags into violation strings."""
+        out = []
+        if vec[0]:
+            out.append("causal log ring overflow (appends clobbered "
+                       "un-truncated determinants)")
+        if vec[1]:
+            out.append("causal log epoch index overflow (> max_epochs "
+                       "un-truncated epochs)")
+        if vec[2]:
+            out.append("causal log absolute offsets near int32 wrap "
+                       "(rebase required)")
+        spilled = self.spill_logs is not None
+        for i in range(len(self.carry.out_rings)):
+            if not spilled and vec[3 + i]:
+                out.append(f"in-flight ring of vertex "
+                           f"{self.compiled.ring_vertices[i]} overflowed "
+                           f"with spill disabled")
+        if vec[3 + len(self.carry.out_rings)]:
+            out.append("replica log ring overflow")
+        return out
 
     def check_overflow(self) -> List[str]:
         """Overflow guards the control plane must heed at every epoch roll
         (VERDICT round-1: these existed but had no caller). Returns a list
         of violation descriptions; empty = healthy."""
-        out = []
-        logs = self.carry.logs
-        cap = self.compiled.log_capacity
-        if bool(jnp.any(logs.head - logs.tail > cap)):
-            out.append("causal log ring overflow (appends clobbered "
-                       "un-truncated determinants)")
-        if bool(jnp.any(logs.latest_epoch - logs.epoch_base + 1
-                        > self.compiled.max_epochs)):
-            out.append("causal log epoch index overflow (> max_epochs "
-                       "un-truncated epochs)")
-        if bool(jnp.any(clog.near_offset_wrap(logs))):
-            out.append("causal log absolute offsets near int32 wrap "
-                       "(rebase required)")
-        spilled = self.spill_logs is not None
-        for i, el in enumerate(self.carry.out_rings):
-            if not spilled and bool(jnp.asarray(ifl.overflowed(el))):
-                out.append(f"in-flight ring of vertex "
-                           f"{self.compiled.ring_vertices[i]} overflowed "
-                           f"with spill disabled")
-        if self.plan_replicas_overflowed():
-            out.append("replica log ring overflow")
-        return out
+        return self.overflow_messages(self.health_vector())
 
     def plan_replicas_overflowed(self) -> bool:
         if self.compiled.plan.num_replicas == 0:
@@ -723,27 +889,33 @@ class LocalExecutor:
         the replicate-before-visible invariant — between blocks.
         TIMESTAMP/RNG rows get a nonzero record-count stamp so the replayer
         can tell them apart from the per-step sync anchors."""
+        self.append_async_many([flat_subtask], d)
+
+    def append_async_many(self, flat_subtasks: Sequence[int],
+                          d: "det.Determinant") -> None:
+        """Append one determinant row to several subtask logs (and every
+        replica of each) in ONE fused device program — the control plane's
+        batch path for SOURCE_CHECKPOINT / IGNORE_CHECKPOINT broadcasts
+        (reference StreamTask.performCheckpoint:833-840 / :891-915)."""
         row = d.pack().copy()
         if row[det.LANE_RC] == 0 and row[det.LANE_TAG] in (det.TIMESTAMP,
                                                            det.RNG):
             row[det.LANE_RC] = self.global_record_stamp()
-        jrow = jnp.asarray(row, jnp.int32)
-        one = jax.tree_util.tree_map(lambda x: x[flat_subtask],
-                                     self.carry.logs)
-        one = clog.append_one(one, jrow)
-        logs = jax.tree_util.tree_map(
-            lambda s, r: s.at[flat_subtask].set(r), self.carry.logs, one)
-        replicas = self.carry.replicas
-        for r in self.compiled.plan.replicas_of(flat_subtask):
-            rep_one = jax.tree_util.tree_map(lambda x: x[r], replicas)
-            rep_one = clog.append_one(rep_one, jrow)
-            replicas = jax.tree_util.tree_map(
-                lambda s, x: s.at[r].set(x), replicas, rep_one)
-        self.carry = self.carry._replace(logs=logs, replicas=replicas)
+        rows1 = np.zeros((self.compiled.L, det.NUM_LANES), np.int32)
+        counts = np.zeros((self.compiled.L,), np.int32)
+        rows1[list(flat_subtasks)] = row
+        counts[list(flat_subtasks)] = 1
+        c = self.carry
+        lr, lh, rr, rh = self._jit_append_many(
+            c.logs.rows, c.logs.head, c.replicas.rows, c.replicas.head,
+            jnp.asarray(rows1), jnp.asarray(counts))
+        self.carry = c._replace(
+            logs=c.logs._replace(rows=lr, head=lh),
+            replicas=c.replicas._replace(rows=rr, head=rh))
 
     def global_record_stamp(self) -> int:
         """Monotone nonzero stamp for async rows (1 + supersteps run)."""
-        return len(self.step_input_history) + 1
+        return self._steps_executed + 1
 
     def service_factory(self, flat_subtask: int,
                         sidecar: "det.SidecarStore",
@@ -758,21 +930,33 @@ class LocalExecutor:
             replay_feed=replay_feed, seed=seed, clock=clock)
 
     def lean_snapshot(self) -> LeanSnapshot:
-        """The fence snapshot handed to the checkpoint coordinator (device
-        references; the coordinator's writer materializes them d2h)."""
-        c = self.carry
-        return LeanSnapshot(
-            op_states=c.op_states, edge_bufs=c.edge_bufs,
-            rr_offsets=c.rr_offsets, record_counts=c.record_counts,
-            log_heads=c.logs.head,
-            ring_heads=tuple(r.head for r in c.out_rings))
+        """The fence snapshot handed to the checkpoint coordinator. The
+        pieces are DEEP-COPIED on device (one jitted program): the live
+        carry's buffers are donated into subsequent block programs, so a
+        reference-holding snapshot would be invalidated by the next
+        block."""
+        if not hasattr(self, "_jit_snap"):
+            def _snap(c: JobCarry) -> LeanSnapshot:
+                cp = lambda t: jax.tree_util.tree_map(
+                    lambda x: jnp.asarray(x).copy(), t)
+                return LeanSnapshot(
+                    op_states=cp(c.op_states), edge_bufs=cp(c.edge_bufs),
+                    rr_offsets=cp(c.rr_offsets),
+                    record_counts=cp(c.record_counts),
+                    log_heads=cp(c.logs.head),
+                    ring_heads=tuple(cp(r.head) for r in c.out_rings))
+            self._jit_snap = jax.jit(_snap)
+        return self._jit_snap(self.carry)
 
     def restore(self, carry_host, epoch_id: int) -> None:
         """Adopt a checkpointed carry (standby restore path; reference
         Task.dispatchStateToStandbyTask -> initializeState). The carry must
         be an epoch-``epoch_id``-boundary snapshot; the next step continues
-        epoch ``epoch_id``."""
-        self.carry = jax.tree_util.tree_map(jnp.asarray, carry_host)
+        epoch ``epoch_id``. Leaves are deep-copied: the live carry is
+        donated into later programs, and aliasing the stored checkpoint's
+        buffers would delete it out of storage on the first step."""
+        self.carry = jax.tree_util.tree_map(
+            lambda x: jnp.asarray(x).copy(), carry_host)
         self.epoch_id = epoch_id
         self.step_in_epoch = 0
 

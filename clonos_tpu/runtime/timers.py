@@ -68,11 +68,19 @@ class ProcessingTimeService:
 
     def force_fire(self, d: det.TimerTriggerDeterminant) -> None:
         """Replay path: fire exactly the recorded timer (and drop its
-        pending registration if present, to avoid double fire)."""
+        pending registration if present, to avoid double fire),
+        re-appending its determinant (append-even-during-replay)."""
+        self._append(d)
+        self.refire(d)
+
+    def refire(self, d: det.TimerTriggerDeterminant) -> None:
+        """Recovery path when the determinant row was already restored
+        into the rebuilt log (block-replay splices async rows back):
+        re-run the callback effect WITHOUT re-appending — a second append
+        would duplicate the recovered row."""
         self._heap = [(ft, cid) for ft, cid in self._heap
                       if not (ft == d.timestamp and cid == d.callback_id)]
         heapq.heapify(self._heap)
-        self._append(d)
         cb = self._callbacks.get(d.callback_id)
         if cb is None:
             raise ValueError(

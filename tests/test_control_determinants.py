@@ -1,0 +1,137 @@
+"""Control-plane determinants on the live path: timer service wiring,
+SOURCE_CHECKPOINT / IGNORE_CHECKPOINT emission, and config-driven runner
+construction (reference StreamTask.performCheckpoint:833-840 /
+ignoreCheckpoint:891-915 / SystemProcessingTimeService.java:50)."""
+
+import numpy as np
+import jax
+import pytest
+
+from clonos_tpu.api.environment import StreamEnvironment
+from clonos_tpu.causal import determinant as det
+from clonos_tpu.causal import log as clog
+from clonos_tpu.config import defaults as D
+from clonos_tpu.config.options import Configuration
+from clonos_tpu.runtime.cluster import ClusterRunner
+
+VOCAB, BATCH, NKEYS = 23, 8, 23
+
+
+def _job(parallelism=2):
+    env = StreamEnvironment(name="wc", num_key_groups=16)
+    (env.synthetic_source(vocab=VOCAB, batch_size=BATCH,
+                          parallelism=parallelism)
+        .key_by()
+        .window_count(num_keys=NKEYS, window_size=50)
+        .sink())
+    return env.build()
+
+
+def _runner(times, **kw):
+    r = ClusterRunner(_job(), steps_per_epoch=3, seed=3, **kw)
+    r.executor.time_source.now = lambda it=iter(times): next(it)
+    return r
+
+
+TIMES = list(range(0, 400, 20))
+
+
+def _log_tags(runner, flat):
+    one = jax.tree_util.tree_map(lambda x: x[flat],
+                                 runner.executor.carry.logs)
+    rows = np.asarray(one.rows)
+    cap = rows.shape[0]
+    tail, head = int(one.tail), int(one.head)
+    pos = [(tail + i) & (cap - 1) for i in range(head - tail)]
+    return rows[pos, det.LANE_TAG].tolist()
+
+
+def test_source_checkpoint_determinant_logged_per_trigger():
+    r = _runner(TIMES)
+    r.run_epoch()
+    r.run_epoch(complete_checkpoint=False)
+    # Source subtasks (flats 0,1) log one SOURCE_CHECKPOINT per trigger.
+    for flat in (0, 1):
+        tags = _log_tags(r, flat)
+        assert tags.count(det.SOURCE_CHECKPOINT) == 2
+    # Non-source subtasks don't.
+    assert _log_tags(r, 2).count(det.SOURCE_CHECKPOINT) == 0
+
+
+def test_ignore_checkpoint_logged_on_recovery():
+    r = _runner(TIMES)
+    r.run_epoch()
+    r.run_epoch(complete_checkpoint=False)   # pending, will be ignored
+    r.inject_failure([3])
+    report = r.recover()
+    assert report.ignored_checkpoints == (1,)
+    # Every healthy subtask logged the ignore decision.
+    for flat in (0, 1, 2):
+        assert _log_tags(r, flat).count(det.IGNORE_CHECKPOINT) == 1
+    # The failed subtask (restored from replicas) did not.
+    assert _log_tags(r, 3).count(det.IGNORE_CHECKPOINT) == 0
+
+
+def test_timer_service_fires_and_replays_after_failure():
+    fired_a, fired_b = [], []
+
+    def build(sink_list):
+        r = _runner(TIMES)
+        svc = r.timer_service(3)             # window subtask 1
+        cid = svc.register_callback(sink_list.append, callback_id=7)
+        svc.register_timer(25, cid)          # fires in epoch 0 (t<=40)
+        svc.register_timer(65, cid)          # fires in epoch 1 (lost range)
+        return r
+
+    a = build(fired_a)                       # golden
+    b = build(fired_b)
+    for r in (a, b):
+        r.run_epoch()                        # epoch 0 completes (t=0,20,40)
+        r.step()                             # t=60
+        r.step()                             # t=80 -> timer 65 fires
+    assert fired_a == fired_b == [25, 65]
+    # Timer 25's row was truncated with checkpoint 0; 65's is live.
+    assert _log_tags(b, 3).count(det.TIMER_TRIGGER) == 1
+
+    b.inject_failure([3])
+    b.recover()
+    # Replay re-fired the lost-range timer effect (25 is checkpointed —
+    # completed effects must NOT re-run) without duplicating rows.
+    assert fired_b == [25, 65, 65]
+    assert _log_tags(b, 3).count(det.TIMER_TRIGGER) == 1
+
+    # And the carries stay bit-identical to the golden run.
+    from clonos_tpu.runtime.executor import canonical_carry
+    for xa, xb in zip(
+            jax.tree_util.tree_leaves(canonical_carry(a.executor.carry)),
+            jax.tree_util.tree_leaves(canonical_carry(b.executor.carry))):
+        np.testing.assert_array_equal(np.asarray(xa), np.asarray(xb))
+
+
+def test_from_config_builds_runner():
+    cfg = (Configuration()
+           .set(D.CHECKPOINT_INTERVAL_STEPS, 4)
+           .set(D.DETERMINANT_LOG_CAPACITY, 512)
+           .set(D.DETERMINANT_MAX_EPOCHS, 8)
+           .set(D.INFLIGHT_CAPACITY_BATCHES, 16)
+           .set(D.NUM_STANDBY_TASKS, 2)
+           .set(D.DETERMINANT_SHARING_DEPTH, 2)
+           .set(D.HEARTBEAT_TIMEOUT_MS, 250))
+    job = _job()
+    r = ClusterRunner.from_config(job, cfg)
+    assert r.executor.steps_per_epoch == 4
+    assert r.executor.compiled.log_capacity == 512
+    assert r.executor.compiled.max_epochs == 8
+    assert r.executor.compiled.inflight_ring_steps == 16
+    assert r.standbys.num_standby_per_vertex == 2
+    assert r.heartbeats.timeout_s == 0.25
+    assert job.sharing_depth == 2
+    r.run_epoch()                            # functional end to end
+
+
+def test_from_config_full_restart_strategy_disables_standby():
+    cfg = Configuration().set(D.FAILOVER_STRATEGY, "full")
+    r = ClusterRunner.from_config(_job(), cfg)
+    assert r.standbys.num_standby_per_vertex == 0
+    with pytest.raises(Exception):
+        r.prewarm_recovery()

@@ -93,3 +93,62 @@ def test_replay_iterator_order_and_skip(tmp_path):
            for s, b in ifl.ReplayIterator(log, 0, 1, skip_steps=1)]
     assert got == [(1, 1), (2, 2), (3, 3), (4, 4)]
     log.close()
+
+
+def test_availability_policy_spills_before_wrap(tmp_path):
+    """The AVAILABILITY-policy hole (round-2/3 advice): a skipped
+    low-occupancy epoch must be retroactively spilled before a later ring
+    wrap clobbers its only copy — recovery across the wrapped gap must
+    still reconstruct every lost step."""
+    from clonos_tpu.api.environment import StreamEnvironment
+    from clonos_tpu.runtime.cluster import ClusterRunner
+    from clonos_tpu.runtime.executor import canonical_carry
+
+    def build():
+        env = StreamEnvironment(name="avail", num_key_groups=8)
+        (env.synthetic_source(vocab=13, batch_size=4, parallelism=2)
+            .key_by().window_count(num_keys=13, window_size=1 << 30)
+            .sink())
+        return env.build()
+
+    def runner(d):
+        r = ClusterRunner(
+            build(), steps_per_epoch=4, log_capacity=1 << 9, max_epochs=16,
+            inflight_ring_steps=8,           # 2 epochs fill the ring
+            spool_dir=str(d), spill_policy=ifl.SpillPolicy.AVAILABILITY,
+            seed=11)
+        r.executor.time_source.now = lambda it=iter(range(0, 10000, 7)): \
+            next(it)
+        return r
+
+    golden = runner(tmp_path / "g")
+    r = runner(tmp_path / "r")
+    for rr in (golden, r):
+        rr.run_epoch(complete_checkpoint=True)    # restore point
+        # Three un-truncated epochs = 12 steps > ring(8): wraps past the
+        # first fill epoch, whose occupancy at close (4/8) was below the
+        # default 0.3? no — 0.5 >= 0.3 spills. Tighten trigger to force
+        # the skip.
+        for sl in rr.executor.spill_logs:
+            sl.availability_trigger = 0.9
+        rr.run_epoch(complete_checkpoint=False)
+        rr.run_epoch(complete_checkpoint=False)
+        rr.run_epoch(complete_checkpoint=False)
+    # The deferred epochs were spilled before the wrap destroyed them.
+    assert any(sl.retained_epochs() for sl in r.executor.spill_logs)
+    r.inject_failure([3])                         # window subtask 1
+    report = r.recover()
+    assert report.steps_replayed == 12
+    # Compare the DATA-path state (op state, edge buffers, rings, record
+    # counts). The causal logs legitimately differ: healthy subtasks
+    # logged IGNORE_CHECKPOINT determinants for the three pending
+    # checkpoints the dead task never acked — a never-failed run has no
+    # such control history (reference StreamTask.ignoreCheckpoint).
+    ca = canonical_carry(r.executor.carry)
+    cb = canonical_carry(golden.executor.carry)
+    for field in ("op_states", "edge_bufs", "rr_offsets",
+                  "record_counts", "out_rings"):
+        for xa, xb in zip(
+                jax.tree_util.tree_leaves(getattr(ca, field)),
+                jax.tree_util.tree_leaves(getattr(cb, field))):
+            np.testing.assert_array_equal(np.asarray(xa), np.asarray(xb))

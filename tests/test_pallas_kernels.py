@@ -9,7 +9,6 @@ import pytest
 
 from clonos_tpu.causal import log as clog
 from clonos_tpu.ops.histogram import keyed_hist
-from clonos_tpu.ops.log_kernels import ring_append_stacked
 
 
 @pytest.mark.parametrize("b", [100, 128, 300])
@@ -27,23 +26,6 @@ def test_keyed_hist_kernel_matches_xla(b):
     s2, c2 = keyed_hist(keys, vals, valid, nk, force="xla")
     np.testing.assert_array_equal(np.asarray(s1), np.asarray(s2))
     np.testing.assert_array_equal(np.asarray(c1), np.asarray(c2))
-
-
-def test_ring_append_matches_scatter_property():
-    rng = np.random.RandomState(7)
-    L, cap, mb = 6, 64, 8
-    state = jax.vmap(lambda _: clog.create(cap, 8))(jnp.arange(L))
-    storage, heads = state.rows, state.head
-    for round_ in range(6):
-        rows = jnp.asarray(rng.randint(-5, 100, (L, mb, 8)), jnp.int32)
-        counts = jnp.asarray(rng.randint(0, mb + 1, L), jnp.int32)
-        storage, heads = ring_append_stacked(storage, heads, rows, counts,
-                                             interpret=True)
-        state = clog.v_append(state, rows, counts)
-    np.testing.assert_array_equal(np.asarray(storage), np.asarray(state.rows))
-    np.testing.assert_array_equal(np.asarray(heads), np.asarray(state.head))
-    # Heads advanced past one wrap of the ring.
-    assert int(jnp.max(heads)) > 0
 
 
 def test_bulk_append_full_matches_masked_append():
