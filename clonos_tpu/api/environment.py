@@ -92,6 +92,50 @@ class DataStream:
                                               window_size=window_size),
             parallelism)
 
+    def window_event_time(self, num_keys: int, window_size: int,
+                          out_of_orderness: int = 0,
+                          name: str = "event-window",
+                          parallelism: Optional[int] = None
+                          ) -> "DataStream":
+        """Event-time tumbling window (watermark = pure fold over record
+        timestamps with bounded out-of-orderness; see
+        operators.EventTimeTumblingWindowOperator)."""
+        from clonos_tpu.api.operators import EventTimeTumblingWindowOperator
+        if not self._keyed:
+            raise ValueError("window_event_time requires key_by() first")
+        return self._attach(
+            name, EventTimeTumblingWindowOperator(
+                num_keys=num_keys, window_size=window_size,
+                out_of_orderness=out_of_orderness), parallelism)
+
+    def window_slide_event_time(self, num_keys: int, window_size: int,
+                                slide: int, out_of_orderness: int = 0,
+                                name: str = "sliding-window",
+                                parallelism: Optional[int] = None
+                                ) -> "DataStream":
+        """Event-time sliding window (SlidingEventTimeWindows analog)."""
+        from clonos_tpu.api.operators import SlidingEventTimeWindowOperator
+        if not self._keyed:
+            raise ValueError(
+                "window_slide_event_time requires key_by() first")
+        return self._attach(
+            name, SlidingEventTimeWindowOperator(
+                num_keys=num_keys, window_size=window_size, slide=slide,
+                out_of_orderness=out_of_orderness), parallelism)
+
+    def window_session(self, num_keys: int, gap: int,
+                       out_of_orderness: int = 0,
+                       name: str = "session-window",
+                       parallelism: Optional[int] = None) -> "DataStream":
+        """Event-time session window (EventTimeSessionWindows analog)."""
+        from clonos_tpu.api.operators import SessionWindowOperator
+        if not self._keyed:
+            raise ValueError("window_session requires key_by() first")
+        return self._attach(
+            name, SessionWindowOperator(
+                num_keys=num_keys, gap=gap,
+                out_of_orderness=out_of_orderness), parallelism)
+
     def _attach2(self, other: "DataStream", name: str, op: Operator,
                  parallelism: Optional[int],
                  capacity: Optional[int] = None) -> "DataStream":
@@ -137,7 +181,14 @@ class DataStream:
         return s
 
     def sink(self, name: str = "sink",
-             parallelism: Optional[int] = None) -> "DataStream":
+             parallelism: Optional[int] = None,
+             transactional: bool = False) -> "DataStream":
+        """``transactional=True`` routes emissions through the 2PC
+        transaction log (exactly-once egress; runtime/txn.py)."""
+        if transactional:
+            from clonos_tpu.api.operators import TransactionalSinkOperator
+            return self._attach(name, TransactionalSinkOperator(),
+                                parallelism)
         return self._attach(name, SinkOperator(), parallelism)
 
     @property

@@ -448,6 +448,273 @@ class TumblingWindowCountOperator(Operator):
         return np.arange(self.num_keys, dtype=np.int32)
 
 
+#: free-slot sentinel for open-window tables; far below any reachable
+#: window id (ids are event_ts // size), and safe in guarded arithmetic.
+_NO_WINDOW = -(2 ** 30)
+
+
+@dataclasses.dataclass
+class EventTimeTumblingWindowOperator(Operator):
+    """Event-time tumbling windowed sum per key with watermark-driven
+    firing (WindowOperator + EventTimeTrigger analog; reference
+    flink-streaming-java .../windowing/WindowOperator.java with
+    watermarks from StreamSourceContexts.java:180-187).
+
+    TPU-first watermark discipline: the watermark is a PURE FOLD over the
+    record timestamps flowing through this operator —
+    ``wm = max(event_ts seen) - out_of_orderness`` — not a timer race.
+    That makes it deterministic given the inputs, so recovery replays it
+    bit-identically with **no watermark determinant at all** (the
+    reference must route watermark generation through causal time because
+    its per-channel arrival interleaving races; the lockstep superstep
+    eliminates the race structurally).
+
+    Batched-watermark discipline: the watermark advances once per
+    superstep, BEFORE the step's records are assigned — so records of one
+    superstep whose timestamps trail the step's own maximum by more than
+    ``out_of_orderness`` are late-dropped. Set ``out_of_orderness`` to at
+    least the expected intra-superstep timestamp spread (the reference's
+    per-record watermark interleaving has the same knob, just at record
+    granularity).
+
+    State per subtask: ``open_windows`` accumulator slots ``acc[W, nk]``
+    with absolute window ids ``win[W]`` (-1 = free), plus ``max_ts``.
+    A record with ts in a window older than every open slot (arrived
+    after its window fired, or slots exhausted) is a LATE DROP — counted
+    in ``late`` like the reference's lateness side-output. Windows whose
+    end <= watermark fire: one record per key with a nonzero sum,
+    timestamped with the window end.
+    """
+
+    num_keys: int
+    window_size: int
+    out_of_orderness: int = 0
+    open_windows: int = 2
+
+    def __post_init__(self):
+        # After fire-first, open window ids span at most
+        # out_of_orderness // window_size + 1 consecutive values; one
+        # spare slot keeps the (rw % W) placement collision-free.
+        need = self.out_of_orderness // self.window_size + 2
+        self.open_windows = max(self.open_windows, need)
+
+    @property
+    def out_capacity(self):  # type: ignore[override]
+        # All open windows may fire in one step.
+        return self.num_keys * self.open_windows
+
+    def init_state(self, parallelism: int):
+        w = self.open_windows
+        return {
+            "acc": jnp.zeros((parallelism, w, self.num_keys), jnp.int32),
+            "win": jnp.full((parallelism, w), _NO_WINDOW, jnp.int32),
+            "max_ts": jnp.full((parallelism,), -(2 ** 31) + 1, jnp.int32),
+            "late": jnp.zeros((parallelism,), jnp.int32),
+        }
+
+    def process(self, state, batch, ctx):
+        nk, w, size = self.num_keys, self.open_windows, self.window_size
+
+        def one(acc, win, max_ts, late, b: RecordBatch):
+            # Advance the watermark from this step's data (pure fold).
+            step_max = jnp.max(jnp.where(b.valid, b.timestamps,
+                                         -(2 ** 31) + 1))
+            max_ts = jnp.maximum(max_ts, step_max)
+            wm = max_ts - self.out_of_orderness
+            # FIRE FIRST: every open window with end <= wm closes, freeing
+            # slots so this step's newest windows can't collide with
+            # stale ones (a window completed by this step's records emits
+            # next step — deterministic one-step emission latency).
+            open_ = win != _NO_WINDOW
+            win_end = (jnp.where(open_, win, 0) + 1) * size   # [W]
+            fire = open_ & (win_end <= wm)                # [W]
+            keys = jnp.broadcast_to(
+                jnp.arange(nk, dtype=jnp.int32)[None, :], (w, nk))
+            out = RecordBatch(
+                keys=keys.reshape(-1),
+                values=acc.reshape(-1),
+                timestamps=jnp.broadcast_to(
+                    win_end[:, None], (w, nk)).reshape(-1),
+                valid=(fire[:, None] & (acc != 0)).reshape(-1))
+            acc = jnp.where(fire[:, None], 0, acc)
+            win = jnp.where(fire, _NO_WINDOW, win)
+            # Assign records to absolute windows.
+            rw = b.timestamps // size          # jnp // floors already
+            closed = (rw + 1) * size <= wm                # behind the wm
+            slot = rw % w
+            slot_win = win[slot]                          # [B]
+            ok = b.valid & ~closed & ((slot_win == rw)
+                                      | (slot_win == _NO_WINDOW))
+            late = late + jnp.sum((b.valid & ~ok).astype(jnp.int32))
+            win = win.at[slot].max(jnp.where(ok, rw, _NO_WINDOW),
+                                   mode="drop")
+            acc = acc.at[slot, jnp.clip(b.keys, 0, nk - 1)].add(
+                jnp.where(ok, b.values, 0), mode="drop")
+            return acc, win, max_ts, late, zero_invalid(out)
+
+        acc, win, max_ts, late, out = jax.vmap(one)(
+            state["acc"], state["win"], state["max_ts"], state["late"],
+            batch)
+        return ({"acc": acc, "win": win, "max_ts": max_ts,
+                 "late": late}, out)
+
+
+@dataclasses.dataclass
+class SlidingEventTimeWindowOperator(Operator):
+    """Event-time SLIDING windowed sum per key: each record contributes to
+    ``size // slide`` consecutive windows (WindowOperator +
+    SlidingEventTimeWindows analog). Window id = its start // slide.
+    Same pure-fold watermark discipline as the tumbling variant."""
+
+    num_keys: int
+    window_size: int
+    slide: int
+    out_of_orderness: int = 0
+    open_windows: int = 4
+
+    def __post_init__(self):
+        if self.window_size % self.slide:
+            raise ValueError("window_size must be a multiple of slide")
+        need = (self.out_of_orderness + self.window_size) // self.slide + 2
+        self.open_windows = max(self.open_windows, need)
+
+    @property
+    def out_capacity(self):  # type: ignore[override]
+        return self.num_keys * self.open_windows
+
+    def init_state(self, parallelism: int):
+        w = self.open_windows
+        return {
+            "acc": jnp.zeros((parallelism, w, self.num_keys), jnp.int32),
+            "win": jnp.full((parallelism, w), _NO_WINDOW, jnp.int32),
+            "max_ts": jnp.full((parallelism,), -(2 ** 31) + 1, jnp.int32),
+            "late": jnp.zeros((parallelism,), jnp.int32),
+        }
+
+    def process(self, state, batch, ctx):
+        nk, w = self.num_keys, self.open_windows
+        size, slide = self.window_size, self.slide
+        per = size // slide
+
+        def one(acc, win, max_ts, late, b: RecordBatch):
+            step_max = jnp.max(jnp.where(b.valid, b.timestamps,
+                                         -(2 ** 31) + 1))
+            max_ts = jnp.maximum(max_ts, step_max)
+            wm = max_ts - self.out_of_orderness
+            # Fire first (see the tumbling variant).
+            open_ = win != _NO_WINDOW
+            win_end = jnp.where(open_, win, 0) * slide + size   # [W]
+            fire = open_ & (win_end <= wm)
+            keys = jnp.broadcast_to(
+                jnp.arange(nk, dtype=jnp.int32)[None, :], (w, nk))
+            out = RecordBatch(
+                keys=keys.reshape(-1),
+                values=acc.reshape(-1),
+                timestamps=jnp.broadcast_to(
+                    win_end[:, None], (w, nk)).reshape(-1),
+                valid=(fire[:, None] & (acc != 0)).reshape(-1))
+            acc = jnp.where(fire[:, None], 0, acc)
+            win = jnp.where(fire, _NO_WINDOW, win)
+            # Newest window containing ts starts at floor(ts/slide)*slide;
+            # the record is in windows starting there minus j*slide.
+            base = b.timestamps // slide       # jnp // floors already
+            ok_any = jnp.zeros_like(b.valid)
+            for j in range(per):
+                rw = base - j                              # window id
+                closed = rw * slide + size <= wm
+                slot = rw % w
+                slot_win = win[slot]
+                ok = b.valid & ~closed & ((slot_win == rw)
+                                          | (slot_win == _NO_WINDOW))
+                ok_any = ok_any | ok
+                win = win.at[slot].max(jnp.where(ok, rw, _NO_WINDOW),
+                                       mode="drop")
+                acc = acc.at[slot, jnp.clip(b.keys, 0, nk - 1)].add(
+                    jnp.where(ok, b.values, 0), mode="drop")
+            # One late increment per record dropped from ALL its windows
+            # (reference numLateRecordsDropped counts elements, not
+            # (element, window) pairs).
+            late = late + jnp.sum((b.valid & ~ok_any).astype(jnp.int32))
+            return acc, win, max_ts, late, zero_invalid(out)
+
+        acc, win, max_ts, late, out = jax.vmap(one)(
+            state["acc"], state["win"], state["max_ts"], state["late"],
+            batch)
+        return ({"acc": acc, "win": win, "max_ts": max_ts,
+                 "late": late}, out)
+
+
+@dataclasses.dataclass
+class SessionWindowOperator(Operator):
+    """Event-time session windows per key: a session absorbs records
+    within ``gap`` of its current end and fires when the watermark passes
+    end + gap (EventTimeSessionWindows analog, dense single-open-session
+    form: one open session per key — a late record for a closed session
+    is a late drop)."""
+
+    num_keys: int
+    gap: int
+    out_of_orderness: int = 0
+
+    @property
+    def out_capacity(self):  # type: ignore[override]
+        return self.num_keys
+
+    def init_state(self, parallelism: int):
+        nk = self.num_keys
+        return {
+            "acc": jnp.zeros((parallelism, nk), jnp.int32),
+            "end": jnp.full((parallelism, nk), -(2 ** 31) + 1, jnp.int32),
+            "max_ts": jnp.full((parallelism,), -(2 ** 31) + 1, jnp.int32),
+            "late": jnp.zeros((parallelism,), jnp.int32),
+        }
+
+    def process(self, state, batch, ctx):
+        nk = self.num_keys
+
+        def one(acc, end, max_ts, late, b: RecordBatch):
+            step_max = jnp.max(jnp.where(b.valid, b.timestamps,
+                                         -(2 ** 31) + 1))
+            max_ts = jnp.maximum(max_ts, step_max)
+            wm = max_ts - self.out_of_orderness
+            # FIRE FIRST: sessions whose (end + gap) the watermark passed
+            # close now, so a later record more than ``gap`` past a stale
+            # end starts a FRESH session instead of merging across the
+            # gap (the docstring's absorb-within-gap contract).
+            live = end > -(2 ** 31) + 1
+            fire = live & (acc != 0) & (end + self.gap <= wm)
+            out = RecordBatch(
+                keys=jnp.arange(nk, dtype=jnp.int32),
+                values=acc,
+                timestamps=end + self.gap,
+                valid=fire)
+            acc = jnp.where(fire, 0, acc)
+            end = jnp.where(fire, -(2 ** 31) + 1, end)
+            live = live & ~fire
+            k = jnp.clip(b.keys, 0, nk - 1)
+            # Absorb: within ``gap`` of the open session's end, or into an
+            # empty slot if the record's own session wouldn't already have
+            # closed (end+gap = ts+gap must still be ahead of the
+            # watermark). Anything else — behind the closed frontier, or
+            # racing ahead of its key's un-fired session within one
+            # superstep — is a late drop.
+            ok = b.valid & jnp.where(
+                live[k],
+                b.timestamps - end[k] <= self.gap,
+                b.timestamps + self.gap > wm)
+            late = late + jnp.sum((b.valid & ~ok).astype(jnp.int32))
+            acc = acc.at[k].add(jnp.where(ok, b.values, 0), mode="drop")
+            end = end.at[k].max(jnp.where(ok, b.timestamps,
+                                          -(2 ** 31) + 1), mode="drop")
+            return acc, end, max_ts, late, zero_invalid(out)
+
+        acc, end, max_ts, late, out = jax.vmap(one)(
+            state["acc"], state["end"], state["max_ts"], state["late"],
+            batch)
+        return ({"acc": acc, "end": end, "max_ts": max_ts,
+                 "late": late}, out)
+
+
 @dataclasses.dataclass
 class UnionOperator(TwoInputOperator):
     """Merge two streams: left records first, then right, compacted into a
@@ -562,6 +829,26 @@ class IntervalJoinOperator(TwoInputOperator):
             state["lv"], state["lt"], state["lm"], state["cursor"],
             left, right)
         return {"lv": lv, "lt": lt, "lm": lm, "cursor": cursor}, out
+
+
+@dataclasses.dataclass
+class TransactionalSinkOperator(Operator):
+    """Exactly-once sink (TwoPhaseCommitSinkFunction analog): emissions
+    flow to the host-side runtime.txn.TransactionLog as per-epoch pending
+    transactions, committed only when the epoch's checkpoint completes.
+    Device-side it is a pass-through counter like SinkOperator."""
+
+    def init_state(self, parallelism: int):
+        return {"emitted": jnp.zeros((parallelism,), jnp.int32)}
+
+    def process(self, state, batch, ctx):
+        return ({"emitted": state["emitted"] + batch.count()},
+                zero_invalid(batch))
+
+    def process_block(self, state, batches, bctx):
+        out = zero_invalid(batches)
+        return ({"emitted": state["emitted"] + out.count().sum(axis=0)},
+                out)
 
 
 @dataclasses.dataclass

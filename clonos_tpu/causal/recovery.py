@@ -106,6 +106,10 @@ class ReplayResult:
         default_factory=list)
     #: wall-clock breakdown of the replay call (parse / device / rebuild).
     phase_ms: Dict[str, float] = dataclasses.field(default_factory=dict)
+    #: True when rebuilt_log_rows is a view of the recovered rows (the
+    #: clean fast path, where verify() already establishes equality) —
+    #: callers must not "re-verify" it against the same buffer.
+    rebuilt_is_view: bool = False
 
     def verify(self) -> None:
         """Post-replay equality asserts (reference LogReplayerImpl:127,
@@ -329,7 +333,8 @@ class LogReplayer:
         # re-derived sync values differ from the recorded rows only in the
         # BUFFER_BUILT payload, and verify() checks exactly that equality —
         # so the rebuilt stream IS the recovered prefix, no copy needed.
-        if not async_events and plan.verify_outputs:
+        rebuilt_is_view = not async_events and plan.verify_outputs
+        if rebuilt_is_view:
             rebuilt = rows[:used]
         else:
             blocks = np.zeros((n, k, det.NUM_LANES), np.int32)
@@ -356,7 +361,7 @@ class LogReplayer:
             emit_counts=emit_np, expected_emits=expected,
             out_chunks=out_chunks if out_chunks else None,
             records_replayed=consumed, async_events=async_events,
-            phase_ms=phases)
+            phase_ms=phases, rebuilt_is_view=rebuilt_is_view)
 
 
 class RecoveryManager:

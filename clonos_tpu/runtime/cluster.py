@@ -200,6 +200,19 @@ class ClusterRunner:
             self.job.subtask_base(v.vertex_id) + s
             for v in self.job.vertices if not self.job.in_edges(v.vertex_id)
             for s in range(v.parallelism)]
+        # Transactional sinks: 2PC egress (runtime/txn.py). Emissions tap
+        # the per-block outputs; transactions seal at fences and commit on
+        # checkpoint completion.
+        from clonos_tpu.api.operators import TransactionalSinkOperator
+        from clonos_tpu.runtime.txn import TransactionLog
+        self.txn_logs: Dict[int, TransactionLog] = {
+            v.vertex_id: TransactionLog(v.vertex_id)
+            for v in job.vertices
+            if isinstance(v.operator, TransactionalSinkOperator)}
+        if self.txn_logs:
+            self.executor.on_block_outputs = self._absorb_sink_outputs
+            self.coordinator.subscribe_completion(
+                lambda e: [tl.commit(e) for tl in self.txn_logs.values()])
         #: recovery chunk size: larger than the live block trades a bigger
         #: prewarm compile for fewer per-chunk dispatches on the failure
         #: path (each costs ~2-10ms of tunnel latency).
@@ -210,12 +223,19 @@ class ClusterRunner:
         if prewarm:
             self.prewarm_recovery()
 
+    def _absorb_sink_outputs(self, outs, epoch: int) -> None:
+        for vid, tl in self.txn_logs.items():
+            b = outs.sinks.get(vid)
+            if b is not None:
+                tl.absorb(epoch, np.asarray(b.keys), np.asarray(b.values),
+                          np.asarray(b.timestamps), np.asarray(b.valid))
+
     # --- compiled recovery programs ------------------------------------------
 
-    def _jitted(self, key, make):
+    def _jitted(self, key, make, donate=()):
         f = self._rjit.get(key)
         if f is None:
-            f = jax.jit(make())
+            f = jax.jit(make(), donate_argnums=donate)
             self._rjit[key] = f
         return f
 
@@ -331,7 +351,7 @@ class ClusterRunner:
         return self._jitted(("replica_copy",), lambda: (
             lambda replicas, logs, ri, oi: jax.tree_util.tree_map(
                 lambda s, l: s.at[ri].set(l[oi], mode="drop"),
-                replicas, logs)))
+                replicas, logs)), donate=(0,))
 
     def _first_chunk_fn(self, eidx: int):
         """Prepend the checkpointed depth-1 edge buffer to the first
@@ -448,6 +468,8 @@ class ClusterRunner:
                 det.SourceCheckpointDeterminant(
                     record_count=self.executor.global_record_stamp(),
                     checkpoint_id=closed, timestamp=t_ms))
+        for tl in self.txn_logs.values():
+            tl.seal(closed)
         if complete_checkpoint:
             self.coordinator.ack_all(closed)
 
@@ -499,7 +521,7 @@ class ClusterRunner:
                     out_rings=tuple(rings),
                     record_counts=carry.record_counts.at[flat].set(0))
             return f
-        return self._jitted(("inject", vid), make)
+        return self._jitted(("inject", vid), make, donate=(0,))
 
     def inject_failure(self, flat_subtasks: Sequence[int]) -> None:
         """Kill subtasks: zero their device state — operator slice, causal
@@ -693,13 +715,26 @@ class ClusterRunner:
                 for _step_i, ad in result.async_events:
                     if isinstance(ad, det.TimerTriggerDeterminant):
                         svc.refire(ad)
+            # Transactional sink: its pending transaction shards died with
+            # the task — rebuild them from the replayed outputs BEFORE any
+            # commit can run (2PC abort+regenerate; TwoPhaseCommitSink
+            # recoverAndAbort analog).
+            if vid in self.txn_logs and n_steps > 0:
+                self.txn_logs[vid].drop_uncommitted_shards(sub)
+                self._rebuild_txn_shards(vid, sub, result, from_epoch,
+                                         fence, n_steps)
             tp = _clock("replay", tp)
 
             rebuilt = np.asarray(result.rebuilt_log_rows)
             # The regenerated determinant rows must equal the recovered ones
             # (bit-identical replay; reference post-replay log asserts).
-            if not synthesized and not np.array_equal(
-                    rebuilt, rows[: rebuilt.shape[0]]):
+            # Skipped when rebuilt IS the recovered buffer (clean path):
+            # verify() already established the only re-derived lane
+            # (BUFFER_BUILT) matches, and comparing a view against itself
+            # would be dead work masquerading as a check.
+            if not synthesized and not result.rebuilt_is_view \
+                    and not np.array_equal(
+                        rebuilt, rows[: rebuilt.shape[0]]):
                 raise rec.RecoveryError(
                     f"subtask {flat}: replayed determinant stream diverges "
                     f"from the recovered log")
@@ -799,9 +834,12 @@ class ClusterRunner:
                 zero((compiled.max_epochs,), jnp.bool_),
                 jnp.asarray(0, jnp.int32), jnp.asarray(0, jnp.int32))
             nr = compiled.plan.num_replicas
+            # Donated arg: hand the prewarm a disposable dummy, never the
+            # live carry (donation deletes the input buffers).
             self._replica_copy_fn()(
-                carry.replicas, carry.logs,
-                jnp.full((nr,), nr, jnp.int32), zero((nr,)))
+                jax.tree_util.tree_map(lambda x: jnp.zeros_like(x),
+                                       carry.replicas),
+                carry.logs, jnp.full((nr,), nr, jnp.int32), zero((nr,)))
         if carry.out_rings:
             self._ring_bounds()
         # Shared log-restore programs.
@@ -859,22 +897,61 @@ class ClusterRunner:
                 rp._jit_block(state0, chunk0, zero((ch,)), zero((ch,)),
                               jnp.asarray(sub, jnp.int32))
                 rp._jit_tslice(zero((ch,)), jnp.asarray(0, jnp.int32))
-            # Graft + kill + ring write.
-            self._graft_fn(vid)(
-                carry, state0, st, jnp.asarray(0, jnp.int32),
+            # Graft + kill + ring write (donated arg 0: disposable
+            # dummies, never the live carry).
+            dummy = jax.tree_util.tree_map(lambda x: jnp.zeros_like(x),
+                                           carry)
+            dummy = self._graft_fn(vid)(
+                dummy, state0, st, jnp.asarray(0, jnp.int32),
                 jnp.asarray(0, jnp.int32), jnp.asarray(0, jnp.int32))
             nrp = max(compiled.plan.num_replicas, 1)
             self._inject_fn(vid)(
-                carry, jnp.asarray(0, jnp.int32), jnp.asarray(0, jnp.int32),
+                dummy, jnp.asarray(0, jnp.int32), jnp.asarray(0, jnp.int32),
                 jnp.full((nrp,), nrp, jnp.int32))
             if vid in compiled.ring_index:
                 ri = compiled.ring_index[vid]
                 out_cap = compiled.vertex_out_capacity(vid)
                 z = jnp.asarray(0, jnp.int32)
                 self._ring_write_fn(ri, ch)(
-                    carry.out_rings[ri], zero_batch((ch, out_cap)),
+                    jax.tree_util.tree_map(lambda x: jnp.zeros_like(x),
+                                           carry.out_rings[ri]),
+                    zero_batch((ch, out_cap)),
                     z, z, jnp.asarray(1, jnp.int32), z)
         return _time.monotonic() - t0
+
+    def _rebuild_txn_shards(self, vid: int, sub: int,
+                            result: rec.ReplayResult, from_epoch: int,
+                            fence: int, n_steps: int) -> None:
+        """Reconstruct the failed sink subtask's pending transaction
+        shards from its replayed output chunks, epoch by epoch."""
+        tl = self.txn_logs[vid]
+        chunks = [jax.tree_util.tree_map(np.asarray, c)
+                  for c in (result.out_chunks or [])]
+
+        def steps_slice(lo: int, hi: int) -> np.ndarray:
+            rows = []
+            for i, c in enumerate(chunks):
+                ch_n = c.keys.shape[0]
+                base = i * self._chunk()
+                a = max(lo, base)
+                b = min(hi, base + ch_n)
+                for s in range(a, b):
+                    m = c.valid[s - base]
+                    if m.any():
+                        rows.append(np.stack(
+                            [c.keys[s - base][m], c.values[s - base][m],
+                             c.timestamps[s - base][m]], axis=1))
+            return (np.concatenate(rows, axis=0) if rows
+                    else np.zeros((0, 3), np.int32))
+
+        cur = self.executor.epoch_id
+        for e in range(from_epoch, cur + 1):
+            if e not in self._fence_step:
+                continue
+            lo = self._fence_step[e] - fence
+            hi = (self._fence_step.get(e + 1, fence + n_steps) - fence
+                  if e < cur else n_steps)
+            tl.rebuild_shard(e, sub, steps_slice(lo, min(hi, n_steps)))
 
     # --- input reconstruction ------------------------------------------------
 
@@ -1150,7 +1227,9 @@ class ClusterRunner:
                     op_states=tuple(ops), logs=logs,
                     record_counts=carry.record_counts.at[flat].set(rc))
             return f
-        return self._jitted(("graft", vid), make)
+        # Donated: an un-donated graft copies the whole multi-GB carry
+        # (rings included) per failed subtask, thrashing the allocator.
+        return self._jitted(("graft", vid), make, donate=(0,))
 
     def _ring_write_fn(self, ri: int, m: int):
         """Write an [m, cap] replayed output chunk into ring ``ri`` at
@@ -1171,7 +1250,7 @@ class ClusterRunner:
                     valid=el.valid.at[pos, sub].set(chunk.valid,
                                                     mode="drop")), base + m
             return f
-        return self._jitted(("ring_write", ri, m), make)
+        return self._jitted(("ring_write", ri, m), make, donate=(0,))
 
     def _patch(self, carry: JobCarry, snap: LeanSnapshot, vid: int,
                sub: int, flat: int, result: rec.ReplayResult,

@@ -599,6 +599,9 @@ class LocalExecutor:
         #: called after every block with (last_causal_time, record_stamp) —
         #: the superstep-boundary hook timer services advance on.
         self.block_listeners: List[Any] = []
+        #: optional hook fed (BlockOutputs, epoch_id) after every block —
+        #: the transactional-sink egress tap (runtime/txn.py).
+        self.on_block_outputs: Optional[Any] = None
 
         owner_idx = self.compiled._owner_idx
         nrep = self.compiled.plan.num_replicas
@@ -707,6 +710,8 @@ class LocalExecutor:
                                            self._next_block_inputs(1))
         self.step_in_epoch += 1
         self._steps_executed += 1
+        if self.on_block_outputs is not None:
+            self.on_block_outputs(outs, self.epoch_id)
         self._notify_block()
         return StepOutputs(
             sinks={vid: jax.tree_util.tree_map(lambda x: x[0], b)
@@ -744,6 +749,8 @@ class LocalExecutor:
                 self.carry, outs = self._jit_block(self.carry, bi)
                 self.step_in_epoch += self.block_steps
                 self._steps_executed += self.block_steps
+                if self.on_block_outputs is not None:
+                    self.on_block_outputs(outs, self.epoch_id)
                 self._notify_block()
         while self.step_in_epoch < self.steps_per_epoch:
             k = min(self.block_steps,
@@ -752,6 +759,8 @@ class LocalExecutor:
                                                self._next_block_inputs(k))
             self.step_in_epoch += k
             self._steps_executed += k
+            if self.on_block_outputs is not None:
+                self.on_block_outputs(outs, self.epoch_id)
             self._notify_block()
         closed = self.epoch_id
         self.epoch_id += 1

@@ -1,0 +1,115 @@
+"""Transactional (exactly-once) sink egress — the 2-phase-commit analog.
+
+Reference: TwoPhaseCommitSinkFunction.java (flink-streaming-java
+.../functions/sink/): outputs accumulate in a per-checkpoint transaction,
+pre-commit on snapshot, commit on notifyCheckpointComplete — so the
+external world only ever observes output backed by a completed
+checkpoint, and a replayed epoch can never double-emit.
+
+TPU mapping: the sink operator stays a device op; the host-side
+TransactionLog buffers each epoch's emitted records as the *pending
+transaction* (sharded per sink subtask, matching the reference's
+one-transaction-per-sink-instance ownership), seals it at the epoch
+fence, and commits when the checkpoint coordinator reports the epoch's
+checkpoint complete. A failed sink subtask loses ITS pending shards
+(they lived with the task); recovery replays the lost epochs and
+rebuilds those shards from the replayed outputs before any commit — so
+the committed stream is bit-identical to a never-failed run's.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class _Txn:
+    epoch: int
+    #: per-subtask accumulated [n, 3] (key, value, timestamp) records
+    shards: Dict[int, List[np.ndarray]] = dataclasses.field(
+        default_factory=dict)
+    sealed: bool = False
+
+
+class TransactionLog:
+    """Per-sink-vertex 2PC state machine with per-subtask transaction
+    shards."""
+
+    def __init__(self, vertex_id: int,
+                 committer: Optional[Callable[[int, np.ndarray], None]]
+                 = None):
+        self.vertex_id = vertex_id
+        self.committer = committer
+        self._pending: Dict[int, _Txn] = {}
+        self.committed: List[Tuple[int, np.ndarray]] = []
+
+    # --- pre-commit side -----------------------------------------------------
+
+    def absorb(self, epoch: int, keys: np.ndarray, values: np.ndarray,
+               timestamps: np.ndarray, valid: np.ndarray) -> None:
+        """Append one block's sink emissions ([K, P, cap] arrays) to the
+        epoch's pending transaction, sharded per subtask."""
+        txn = self._pending.setdefault(epoch, _Txn(epoch))
+        if txn.sealed:
+            raise RuntimeError(f"epoch {epoch} transaction already sealed")
+        p = keys.shape[1]
+        for sub in range(p):
+            m = valid[:, sub].reshape(-1)
+            flat = np.stack([keys[:, sub].reshape(-1)[m],
+                             values[:, sub].reshape(-1)[m],
+                             timestamps[:, sub].reshape(-1)[m]], axis=1)
+            txn.shards.setdefault(sub, []).append(flat)
+
+    def seal(self, epoch: int) -> None:
+        """Epoch fence: the transaction stops accepting records
+        (pre-commit; reference preCommit on snapshot)."""
+        self._pending.setdefault(epoch, _Txn(epoch)).sealed = True
+
+    # --- commit / abort ------------------------------------------------------
+
+    def commit(self, epoch: int) -> None:
+        """Checkpoint complete: externalize every sealed transaction up to
+        ``epoch``, subtask-major within an epoch (commits are ordered;
+        reference commit on notifyCheckpointComplete)."""
+        for e in sorted(self._pending):
+            if e > epoch:
+                break
+            txn = self._pending.pop(e)
+            parts = [np.concatenate(txn.shards[s], axis=0)
+                     for s in sorted(txn.shards) if txn.shards[s]]
+            recs = (np.concatenate(parts, axis=0) if parts
+                    else np.zeros((0, 3), np.int32))
+            self.committed.append((e, recs))
+            if self.committer is not None:
+                self.committer(e, recs)
+
+    def drop_uncommitted_shards(self, sub: int) -> List[int]:
+        """Sink-subtask failure: its pending shards lived with the task
+        and are lost; recovery rebuilds them from replayed outputs."""
+        lost = []
+        for e, txn in self._pending.items():
+            if sub in txn.shards:
+                del txn.shards[sub]
+                lost.append(e)
+        return sorted(lost)
+
+    def rebuild_shard(self, epoch: int, sub: int,
+                      records: np.ndarray) -> None:
+        """Install a replay-reconstructed shard for (epoch, subtask)."""
+        txn = self._pending.setdefault(epoch, _Txn(epoch))
+        txn.shards[sub] = [records]
+
+    # --- introspection -------------------------------------------------------
+
+    def committed_stream(self) -> np.ndarray:
+        """All committed records in commit order — what the external
+        consumer has observed."""
+        if not self.committed:
+            return np.zeros((0, 3), np.int32)
+        return np.concatenate([r for _, r in self.committed], axis=0)
+
+    def pending_epochs(self) -> List[int]:
+        return sorted(self._pending)

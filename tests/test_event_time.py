@@ -1,0 +1,208 @@
+"""Event-time windows + watermarks: semantics against a python oracle,
+block == scan equivalence, and bit-identical recovery under failure
+(reference WindowOperator event-time/sliding/session breadth with
+watermarks; here the watermark is a pure fold over record timestamps so
+replay needs no watermark determinant)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from clonos_tpu.api.environment import StreamEnvironment
+from clonos_tpu.api.operators import (
+    BlockContext, EventTimeTumblingWindowOperator, Operator,
+    SessionWindowOperator, SlidingEventTimeWindowOperator)
+from clonos_tpu.api.records import RecordBatch, zero_invalid
+from clonos_tpu.runtime.cluster import ClusterRunner
+
+
+def _step_batch(recs, cap=8, p=1):
+    keys = np.zeros((p, cap), np.int32)
+    vals = np.zeros((p, cap), np.int32)
+    ts = np.zeros((p, cap), np.int32)
+    valid = np.zeros((p, cap), bool)
+    for j, (k, v, t) in enumerate(recs):
+        keys[0, j], vals[0, j], ts[0, j], valid[0, j] = k, v, t, True
+    return zero_invalid(RecordBatch(jnp.asarray(keys), jnp.asarray(vals),
+                                    jnp.asarray(ts), jnp.asarray(valid)))
+
+
+def _ctx(p=1):
+    return BlockContext(
+        times=jnp.zeros((1,), jnp.int32), rng_bits=jnp.zeros((1,), jnp.int32),
+        epoch=jnp.zeros((), jnp.int32), step0=jnp.zeros((), jnp.int32),
+        subtask=jnp.arange(p, dtype=jnp.int32)).at_step(0)
+
+
+def _run_steps(op, steps):
+    state = op.init_state(1)
+    fired = []
+    for recs in steps:
+        state, out = op.process(state, _step_batch(recs), _ctx())
+        m = np.asarray(out.valid[0])
+        for k, v, t in zip(np.asarray(out.keys[0])[m],
+                           np.asarray(out.values[0])[m],
+                           np.asarray(out.timestamps[0])[m]):
+            fired.append((int(k), int(v), int(t)))
+    return state, fired
+
+
+def test_tumbling_event_time_fires_on_watermark():
+    op = EventTimeTumblingWindowOperator(num_keys=4, window_size=10,
+                                         out_of_orderness=5)
+    state, fired = _run_steps(op, [
+        [(1, 2, 3), (2, 1, 7)],          # window 0
+        [(1, 1, 12)],                    # window 1; wm=7: nothing closes
+        [(1, 1, 9)],                     # late-ish but wm=7 allows w0
+        [(2, 5, 21)],                    # wm=16 -> window 0 fires
+        [(3, 1, 40)],                    # wm=35 -> windows 1,2 fire
+    ])
+    assert (1, 3, 10) in fired and (2, 1, 10) in fired   # window 0 sums
+    assert (1, 1, 20) in fired                           # window 1
+    assert (2, 5, 30) in fired                           # window 2
+    assert int(state["late"][0]) == 0
+
+
+def test_tumbling_late_records_dropped_and_counted():
+    op = EventTimeTumblingWindowOperator(num_keys=4, window_size=10,
+                                         out_of_orderness=0)
+    state, fired = _run_steps(op, [
+        [(1, 1, 5)],
+        [(1, 1, 25)],                    # wm=25 -> window 0,1 closed
+        [(1, 9, 3)],                     # late: window 0 already closed
+    ])
+    assert int(state["late"][0]) == 1
+    assert (1, 1, 10) in fired
+    assert all(v != 9 for _, v, _ in fired)
+
+
+def test_sliding_event_time_oracle():
+    op = SlidingEventTimeWindowOperator(num_keys=4, window_size=20,
+                                        slide=10, out_of_orderness=0)
+    state, fired = _run_steps(op, [
+        [(1, 1, 5)],                     # windows starting at -10, 0
+        [(1, 2, 15)],                    # windows 0, 10
+        [(1, 4, 42)],                    # wm=42: windows [-10,10],[0,20],
+                                         # [10,30] close
+    ])
+    # window [0, 20) = 1+2 = 3; window [-10, 10) = 1; window [10, 30) = 2
+    assert (1, 1, 10) in fired
+    assert (1, 3, 20) in fired
+    assert (1, 2, 30) in fired
+
+
+def test_session_window_gap_merging_and_late():
+    op = SessionWindowOperator(num_keys=4, gap=10, out_of_orderness=0)
+    state, fired = _run_steps(op, [
+        [(1, 1, 0), (1, 2, 5)],          # one session [0, 5]
+        [(1, 3, 12)],                    # extends (12 - 5 < gap... 7<10)
+        [(2, 1, 40)],                    # wm=40 -> key1 session fires
+    ])
+    assert (1, 6, 22) in fired           # sum 6, end 12+gap
+    s2, fired2 = _run_steps(op, [
+        [(1, 1, 0)],
+        [(2, 1, 50)],                    # closes key1's session
+        [(1, 5, 2)],                     # late for the closed frontier
+    ])
+    assert (1, 1, 10) in fired2
+    assert int(s2["late"][0]) == 1
+
+
+@pytest.mark.parametrize("op", [
+    EventTimeTumblingWindowOperator(num_keys=5, window_size=8,
+                                    out_of_orderness=6),
+    SlidingEventTimeWindowOperator(num_keys=5, window_size=8, slide=4,
+                                   out_of_orderness=6),
+    SessionWindowOperator(num_keys=5, gap=6, out_of_orderness=4),
+])
+def test_event_windows_block_equals_scan(op):
+    rng = np.random.RandomState(0)
+    K, P, B = 6, 2, 8
+    keys = rng.randint(0, 5, (K, P, B)).astype(np.int32)
+    vals = rng.randint(1, 4, (K, P, B)).astype(np.int32)
+    # Mostly-increasing event times with bounded disorder.
+    base = np.sort(rng.randint(0, 60, (K, P, B)), axis=0).astype(np.int32)
+    valid = rng.rand(K, P, B) < 0.8
+    batches = zero_invalid(RecordBatch(
+        jnp.asarray(keys), jnp.asarray(vals), jnp.asarray(base),
+        jnp.asarray(valid)))
+    bctx = BlockContext(
+        times=jnp.arange(K, dtype=jnp.int32),
+        rng_bits=jnp.zeros((K,), jnp.int32),
+        epoch=jnp.zeros((), jnp.int32), step0=jnp.zeros((), jnp.int32),
+        subtask=jnp.arange(P, dtype=jnp.int32))
+    state = op.init_state(P)
+    ref = jax.jit(lambda s, b, c: Operator.process_block(op, s, b, c))(
+        state, batches, bctx)
+    blk = jax.jit(op.process_block)(state, batches, bctx)
+    for xa, xb in zip(jax.tree_util.tree_leaves(ref),
+                      jax.tree_util.tree_leaves(blk)):
+        np.testing.assert_array_equal(np.asarray(xa), np.asarray(xb))
+
+
+def test_event_time_job_recovers_bit_identically():
+    """An event-time window job survives a window-subtask failure with
+    bit-identical state — watermarks replay because they are a pure
+    function of the replayed inputs (no watermark determinant)."""
+    def build():
+        env = StreamEnvironment(name="evt", num_key_groups=16)
+        (env.synthetic_source(vocab=19, batch_size=6, parallelism=2)
+            .key_by()
+            .window_event_time(num_keys=19, window_size=64,
+                               out_of_orderness=16)
+            .sink())
+        return env.build()
+
+    def runner():
+        r = ClusterRunner(build(), steps_per_epoch=3, seed=3)
+        r.executor.time_source.now = \
+            lambda it=iter(range(0, 4000, 20)): next(it)
+        return r
+
+    golden = runner()
+    r = runner()
+    for rr in (golden, r):
+        rr.run_epoch()
+        rr.step()
+        rr.step()
+    r.inject_failure([3])               # window subtask 1
+    rep = r.recover()
+    assert rep.steps_replayed == 2
+    from clonos_tpu.runtime.executor import canonical_carry
+    for xa, xb in zip(
+            jax.tree_util.tree_leaves(canonical_carry(r.executor.carry)),
+            jax.tree_util.tree_leaves(
+                canonical_carry(golden.executor.carry))):
+        np.testing.assert_array_equal(np.asarray(xa), np.asarray(xb))
+    golden.step()
+    r.step()
+    for xa, xb in zip(
+            jax.tree_util.tree_leaves(canonical_carry(r.executor.carry)),
+            jax.tree_util.tree_leaves(
+                canonical_carry(golden.executor.carry))):
+        np.testing.assert_array_equal(np.asarray(xa), np.asarray(xb))
+
+
+def test_session_far_apart_records_make_two_sessions():
+    """Records separated by more than gap must NOT merge (review finding:
+    the absorb rule needs the gap-distance check, not just the frontier)."""
+    op = SessionWindowOperator(num_keys=4, gap=10, out_of_orderness=0)
+    state, fired = _run_steps(op, [
+        [(1, 1, 50)],
+        [(1, 2, 95)],                    # 45 > gap: closes the first
+        [(2, 1, 200)],                   # closes the second
+    ])
+    assert (1, 1, 60) in fired
+    assert (1, 2, 105) in fired
+    assert all(v != 3 for _, v, _ in fired)   # never merged
+
+
+def test_tumbling_negative_timestamps_floor_correctly():
+    op = EventTimeTumblingWindowOperator(num_keys=4, window_size=10,
+                                         out_of_orderness=0)
+    state, fired = _run_steps(op, [
+        [(1, 7, -10)],                   # window [-10, 0), id -1
+        [(2, 1, 50)],                    # wm=50 closes it
+    ])
+    assert (1, 7, 0) in fired
