@@ -53,12 +53,139 @@ def build_job():
     return env.build()
 
 
+def bench_config4():
+    """BASELINE config #4: Kafka-like feed source -> keyBy -> window ->
+    keyBy -> reduce -> sink, 64 tasks, connected/cascading failures
+    (scaled-down steps to bound bench wall-clock; full protocol)."""
+    import jax
+    from clonos_tpu.api.environment import StreamEnvironment
+    from clonos_tpu.api.feeds import ListFeedReader
+    from clonos_tpu.runtime.cluster import ClusterRunner
+
+    P4, B4, SPE = 16, 32, 64
+    env = StreamEnvironment(name="bench-c4", num_key_groups=64,
+                            default_edge_capacity=512)
+    (env.host_source(batch_size=B4, parallelism=P4)
+        .key_by().window_count(num_keys=499, window_size=1 << 30,
+                               parallelism=P4)
+        .key_by().reduce(num_keys=499, parallelism=P4)
+        .sink(parallelism=P4))
+    job = env.build()
+    rng = np.random.RandomState(5)
+    total = 4 * SPE * B4
+    feed = ListFeedReader([
+        [(int(k), 1) for k in rng.randint(0, 499, total)]
+        for _ in range(P4)])
+    runner = ClusterRunner(job, steps_per_epoch=SPE, log_capacity=1 << 11,
+                           max_epochs=16, inflight_ring_steps=1 << 8,
+                           seed=5)
+    runner.executor.register_feed(0, feed)
+    runner.run_epoch(complete_checkpoint=True)
+    runner.run_epoch(complete_checkpoint=False)
+    device_sync(runner.executor.carry)
+    # Cascading connected failures: feed source + window + reduce subtasks
+    # on one path (3 vertex classes at once).
+    wbase = job.subtask_base(1)
+    rbase = job.subtask_base(2)
+    runner.inject_failure([2, wbase + 3, rbase + 7])
+    t0 = time.monotonic()
+    report = runner.recover()
+    device_sync(runner.executor.carry)
+    return {
+        "subtasks": job.total_subtasks(),
+        "failed": list(report.failed_subtasks),
+        "steps_replayed": report.steps_replayed,
+        "records_replayed": report.records_replayed,
+        "recovery_ms": round((time.monotonic() - t0) * 1e3, 1),
+    }
+
+
+def bench_config5():
+    """BASELINE config #5: NEXMark-style two-source keyed interval join
+    with CausalSerializableService calls, 128 tasks (scaled-down
+    determinant volume; external-call sidecar replay exercised)."""
+    import jax
+    from clonos_tpu.api.environment import StreamEnvironment
+    from clonos_tpu.causal import determinant as det
+    from clonos_tpu.runtime.cluster import ClusterRunner
+
+    P5, SPE = 32, 64
+    env = StreamEnvironment(name="bench-c5", num_key_groups=64,
+                            default_edge_capacity=256)
+    left = env.synthetic_source(vocab=211, batch_size=16,
+                                parallelism=P5).key_by()
+    right = env.synthetic_source(vocab=211, batch_size=16,
+                                 parallelism=P5, name="source-r").key_by()
+    (left.join(right, num_keys=211, window=4, interval=1 << 30,
+               parallelism=P5)
+         .sink(parallelism=P5))
+    job = env.build()
+    runner = ClusterRunner(job, steps_per_epoch=SPE, log_capacity=1 << 11,
+                           max_epochs=16, inflight_ring_steps=1 << 8,
+                           seed=9)
+    # External CausalSerializableService calls on a join subtask: values
+    # record to its log (+ sidecar) and replay after failure.
+    jbase = job.subtask_base(2)
+    sidecar = det.SidecarStore()
+    svc = runner.executor.service_factory(jbase + 1, sidecar)
+    ext = svc.serializable_service(lambda q: b"answer:" + q)
+    runner.run_epoch(complete_checkpoint=True)
+    calls_live = [ext.apply(b"q%d" % i) for i in range(3)]
+    runner.run_epoch(complete_checkpoint=False)
+    device_sync(runner.executor.carry)
+    dets = int(np.sum(runner.executor.log_sizes()))
+    runner.inject_failure([jbase + 1])
+    t0 = time.monotonic()
+    report = runner.recover()
+    device_sync(runner.executor.carry)
+    # The recovered log must still hold the external-call determinants.
+    replayed_async = sum(
+        1 for _s, d in report.managers[0].result.async_events
+        if d.TAG == det.SERIALIZABLE)
+    return {
+        "subtasks": job.total_subtasks(),
+        "buffered_determinants": dets,
+        "external_calls_live": len(calls_live),
+        "external_calls_replayed": replayed_async,
+        "steps_replayed": report.steps_replayed,
+        "records_replayed": report.records_replayed,
+        "recovery_ms": round((time.monotonic() - t0) * 1e3, 1),
+    }
+
+
+def sharing_depth_sweep():
+    """THE Clonos trade-off knob (ExecutionConfig.setDeterminantSharingDepth,
+    reference .../api/common/ExecutionConfig.java:297-310): replication
+    memory vs how many connected failures survive. The replication plan is
+    host-side, so the sweep is analytic over the bench topology."""
+    from clonos_tpu.causal import determinant as det_mod
+    from clonos_tpu.causal.replication import ReplicationPlan
+
+    job = build_job()
+    out = []
+    for depth in (1, 2, -1):
+        job.sharing_depth = depth
+        plan = ReplicationPlan.from_job(job, depth)
+        cap = 1 << 17
+        out.append({
+            "depth": depth,
+            "replica_logs": plan.num_replicas,
+            "replica_bytes": plan.num_replicas * cap * 8 * 4,
+            "survives_connected_failures": (
+                "any" if depth == -1 else depth),
+        })
+    job.sharing_depth = -1
+    return out
+
+
 def main():
     import jax
     from clonos_tpu.runtime.cluster import ClusterRunner
     from clonos_tpu.runtime.executor import DETS_PER_STEP
     from clonos_tpu.causal import recovery as rec
 
+    global T_START
+    T_START = time.monotonic()
     job = build_job()
     # Log capacity sized to hold FILL_EPOCHS * STEPS_PER_EPOCH * 4 sync
     # rows plus control-plane determinants (SOURCE_CHECKPOINT per trigger).
@@ -158,6 +285,22 @@ def main():
         "subtasks": job.total_subtasks(),
         "device": str(jax.devices()[0].platform),
     }
+    # Secondary BASELINE configs (#4 cascading, #5 join + external-service
+    # calls) and the determinant-sharing-depth trade-off sweep. Guarded by
+    # a wall-clock budget so the primary metric always prints.
+    budget_s = float(os.environ.get("BENCH_MAX_S", 1500))
+    for key, fn in (("config4_kafka_window_64task_cascading",
+                     bench_config4),
+                    ("config5_join_128task_external_services",
+                     bench_config5)):
+        if time.monotonic() - T_START > budget_s:
+            out[key] = {"skipped": "bench wall-clock budget exhausted"}
+            continue
+        try:
+            out[key] = fn()
+        except Exception as e:                        # pragma: no cover
+            out[key] = {"error": str(e)}
+    out["sharing_depth_sweep"] = sharing_depth_sweep()
     print(json.dumps(out))
 
 

@@ -682,12 +682,16 @@ class SessionWindowOperator(Operator):
             # end starts a FRESH session instead of merging across the
             # gap (the docstring's absorb-within-gap contract).
             live = end > -(2 ** 31) + 1
-            fire = live & (acc != 0) & (end + self.gap <= wm)
+            # A session CLOSES whenever the watermark passes end+gap —
+            # even with a zero-sum accumulator (which merely emits
+            # nothing); gating the slot reset on acc != 0 would wedge the
+            # key forever after a zero-valued session.
+            fire = live & (end + self.gap <= wm)
             out = RecordBatch(
                 keys=jnp.arange(nk, dtype=jnp.int32),
                 values=acc,
                 timestamps=end + self.gap,
-                valid=fire)
+                valid=fire & (acc != 0))
             acc = jnp.where(fire, 0, acc)
             end = jnp.where(fire, -(2 ** 31) + 1, end)
             live = live & ~fire

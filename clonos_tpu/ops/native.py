@@ -1,0 +1,94 @@
+"""ctypes loader for the C++ runtime components (native/*.cpp).
+
+The compute path is JAX/XLA/Pallas; the byte-level runtime around it
+(delta wire codec CRC/framing) is C++ where the reference's is native
+(Netty direct buffers). No pybind11 in the image, so the boundary is
+plain C ABI via ctypes; builds lazily with the baked-in toolchain and
+falls back to bit-identical pure Python (zlib) when compilation is
+unavailable. ``tests/test_serde.py`` pins native == fallback.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+import zlib
+
+import numpy as np
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
+
+def _load():
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        src = os.path.join(_repo_root(), "native", "delta_codec.cpp")
+        if not os.path.exists(src):
+            return None
+        so = os.path.join(_repo_root(), "native", "libdelta_codec.so")
+        try:
+            if (not os.path.exists(so)
+                    or os.path.getmtime(so) < os.path.getmtime(src)):
+                subprocess.run(
+                    ["c++", "-O3", "-shared", "-fPIC", "-o", so, src],
+                    check=True, capture_output=True, timeout=120)
+            lib = ctypes.CDLL(so)
+            lib.dc_crc32.restype = ctypes.c_uint32
+            lib.dc_crc32.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+            lib.dc_encode_flat.restype = ctypes.c_int64
+            lib.dc_encode_flat.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_int32, ctypes.c_void_p, ctypes.c_int32,
+                ctypes.c_void_p, ctypes.c_int64]
+            _lib = lib
+        except Exception:
+            _lib = None
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def crc32(rows: np.ndarray) -> int:
+    """CRC-32 (zlib polynomial) over a contiguous int32 array."""
+    rows = np.ascontiguousarray(rows, dtype=np.int32)
+    lib = _load()
+    if lib is not None:
+        return int(lib.dc_crc32(rows.ctypes.data, rows.nbytes))
+    return zlib.crc32(rows.tobytes()) & 0xFFFFFFFF
+
+
+def encode_flat_entries(log_ids: np.ndarray, starts: np.ndarray,
+                        n_rows: np.ndarray, rows_concat: np.ndarray,
+                        lanes: int) -> bytes:
+    """FLAT delta entry stream (everything after the frame header) in one
+    native pass; None-safe fallback is handled by the caller (serde)."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native codec unavailable")
+    log_ids = np.ascontiguousarray(log_ids, np.int32)
+    starts = np.ascontiguousarray(starts, np.int32)
+    n_rows_a = np.ascontiguousarray(n_rows, np.uint32)
+    rows_concat = np.ascontiguousarray(rows_concat, np.int32)
+    cap = (12 + 4) * len(log_ids) + rows_concat.nbytes + 16
+    out = (ctypes.c_uint8 * cap)()
+    n = lib.dc_encode_flat(
+        log_ids.ctypes.data, starts.ctypes.data, n_rows_a.ctypes.data,
+        len(log_ids), rows_concat.ctypes.data, lanes,
+        ctypes.addressof(out), cap)
+    if n < 0:
+        raise RuntimeError("native encode buffer overflow")
+    return bytes(bytearray(out)[:n])

@@ -1,0 +1,131 @@
+"""Control-plane transport: length-prefixed typed messages over TCP.
+
+The reference's control plane is Akka actor RPC with typed gateways
+(rpc/akka/AkkaRpcService.java:84, TaskExecutorGateway.java:170-233) and
+its recovery events flow in-band over netty data channels
+(DeterminantRequestEvent / DeterminantResponseEvent /
+InFlightLogRequestEvent). The TPU build keeps intra-chip coordination as
+host calls (one process, one device), and uses THIS transport for the
+cross-host analogs: registration, heartbeats, checkpoint RPCs, and
+determinant-delta fetches between a running host and a remote standby
+host (runtime/remote.py drives it; the delta bytes use causal/serde.py).
+
+Wire: frame = u32 length | u16 msg_type | payload. Payloads are either
+raw bytes (delta frames) or UTF-8 JSON for small control records —
+explicit, versionable, no pickle on the wire.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import socketserver
+import struct
+import threading
+from typing import Any, Callable, Dict, Optional, Tuple
+
+_FRAME = struct.Struct("<IH")
+
+# message types (reference gateway methods / task events)
+REGISTER = 1               # TaskExecutor -> JobMaster
+HEARTBEAT = 2              # TaskExecutor -> JobMaster
+TRIGGER_CHECKPOINT = 3     # JobMaster -> TaskExecutor
+ACK_CHECKPOINT = 4
+NOTIFY_COMPLETE = 5
+IGNORE_CHECKPOINT = 6      # rpcIgnoreUnacknowledgedPendingCheckpointsFor
+DETERMINANT_REQUEST = 7    # standby host -> running host
+DETERMINANT_RESPONSE = 8   # payload = serde delta frame
+INFLIGHT_REQUEST = 9
+INFLIGHT_RESPONSE = 10
+SHUTDOWN = 11
+OK = 12
+ERROR = 13
+
+
+def _send(sock: socket.socket, mtype: int, payload: bytes) -> None:
+    sock.sendall(_FRAME.pack(len(payload), mtype) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return buf
+
+
+def _recv(sock: socket.socket) -> Tuple[int, bytes]:
+    hdr = _recv_exact(sock, _FRAME.size)
+    length, mtype = _FRAME.unpack(hdr)
+    return mtype, _recv_exact(sock, length)
+
+
+def pack_json(obj: Any) -> bytes:
+    return json.dumps(obj).encode("utf-8")
+
+
+def unpack_json(b: bytes) -> Any:
+    return json.loads(b.decode("utf-8"))
+
+
+class ControlServer:
+    """Threaded request/response endpoint. ``handler(mtype, payload) ->
+    (mtype, payload)`` runs per request; one TCP connection may carry many
+    requests (the typed-gateway analog)."""
+
+    def __init__(self, handler: Callable[[int, bytes], Tuple[int, bytes]],
+                 host: str = "127.0.0.1", port: int = 0):
+        outer = self
+
+        class _H(socketserver.BaseRequestHandler):
+            def handle(self):
+                try:
+                    while True:
+                        mtype, payload = _recv(self.request)
+                        if mtype == SHUTDOWN:
+                            _send(self.request, OK, b"")
+                            return
+                        try:
+                            rt, rp = outer._handler(mtype, payload)
+                        except Exception as e:       # surface, don't die
+                            rt, rp = ERROR, pack_json({"error": str(e)})
+                        _send(self.request, rt, rp)
+                except (ConnectionError, OSError):
+                    return
+
+        self._handler = handler
+        self._srv = socketserver.ThreadingTCPServer((host, port), _H)
+        self._srv.daemon_threads = True
+        self.address: Tuple[str, int] = self._srv.server_address[:2]
+        self._thread = threading.Thread(target=self._srv.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def close(self) -> None:
+        self._srv.shutdown()
+        self._srv.server_close()
+
+
+class ControlClient:
+    """Blocking request/response client for a ControlServer."""
+
+    def __init__(self, address: Tuple[str, int], timeout_s: float = 10.0):
+        self._sock = socket.create_connection(address, timeout=timeout_s)
+
+    def call(self, mtype: int, payload: bytes = b"") -> Tuple[int, bytes]:
+        _send(self._sock, mtype, payload)
+        return _recv(self._sock)
+
+    def call_json(self, mtype: int, obj: Any) -> Any:
+        rt, rp = self.call(mtype, pack_json(obj))
+        if rt == ERROR:
+            raise RuntimeError(unpack_json(rp)["error"])
+        return unpack_json(rp) if rp else None
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass

@@ -206,3 +206,17 @@ def test_tumbling_negative_timestamps_floor_correctly():
         [(2, 1, 50)],                    # wm=50 closes it
     ])
     assert (1, 7, 0) in fired
+
+
+def test_session_zero_sum_session_closes_and_key_recovers():
+    """A session whose values sum to zero must still close on watermark
+    passage (no emission) and free the key for later sessions."""
+    op = SessionWindowOperator(num_keys=4, gap=10, out_of_orderness=0)
+    state, fired = _run_steps(op, [
+        [(1, 0, 0)],                     # zero-valued session
+        [(2, 1, 100)],                   # wm=100 closes it silently
+        [(1, 5, 200)],                   # key 1 must accept a new session
+        [(2, 1, 300)],                   # closes it
+    ])
+    assert (1, 5, 210) in fired
+    assert int(state["late"][0]) == 0

@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""TPU correctness lane: runs the recovery property and the Pallas
+histogram kernel on the REAL chip (the pytest suite forces CPU via
+tests/conftest.py; this script is the driver-invokable complement so
+bit-identical recovery and the Mosaic-compiled kernel are exercised on
+hardware, round-3 verdict item #9).
+
+Exit 0 = all checks passed; prints one status line per check.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def check(name, fn):
+    t0 = time.monotonic()
+    fn()
+    print(f"PASS {name} ({time.monotonic() - t0:.1f}s)", flush=True)
+
+
+def pallas_histogram_on_chip():
+    import jax
+    import jax.numpy as jnp
+    from clonos_tpu.ops.histogram import keyed_hist
+    assert jax.devices()[0].platform == "tpu", "no TPU visible"
+    rng = np.random.RandomState(0)
+    nk = 997
+    keys = jnp.asarray(rng.randint(-3, nk + 5, (64, 8, 300)), jnp.int32)
+    vals = jnp.asarray(rng.randint(-9, 9, (64, 8, 300)), jnp.int32)
+    valid = jnp.asarray(rng.rand(64, 8, 300) < 0.7)
+    s1, c1 = keyed_hist(keys, vals, valid, nk, force="pallas")
+    s2, c2 = keyed_hist(keys, vals, valid, nk, force="xla")
+    np.testing.assert_array_equal(np.asarray(s1), np.asarray(s2))
+    np.testing.assert_array_equal(np.asarray(c1), np.asarray(c2))
+
+
+def recovery_per_vertex_class_on_chip():
+    """Bench topology (source -> window -> reduce -> sink), one failure
+    per vertex class, each recovery bit-identical to a golden run —
+    executed on the real chip."""
+    import jax
+    from clonos_tpu.api.environment import StreamEnvironment
+    from clonos_tpu.runtime.cluster import ClusterRunner
+    from clonos_tpu.runtime.executor import canonical_carry
+
+    def build():
+        env = StreamEnvironment(name="tpu-check", num_key_groups=16,
+                                default_edge_capacity=128)
+        (env.synthetic_source(vocab=97, batch_size=16, parallelism=2)
+            .key_by().window_count(num_keys=97, window_size=60)
+            .key_by().reduce(num_keys=97)
+            .sink())
+        return env.build()
+
+    def runner():
+        r = ClusterRunner(build(), steps_per_epoch=4, log_capacity=1 << 9,
+                          max_epochs=16, inflight_ring_steps=32, seed=3)
+        r.executor.time_source.now = \
+            lambda it=iter(range(0, 40000, 9)): next(it)
+        return r
+
+    for flat in (0, 3, 5, 7):            # source, window, reduce, sink
+        golden = runner()
+        r = runner()
+        for rr in (golden, r):
+            rr.run_epoch()               # completed: no pending ckpt, so
+            rr.step()                    # recovery logs no IGNORE rows
+            rr.step()                    # (those legitimately differ
+            rr.step()                    # from a never-failed run)
+        r.inject_failure([flat])
+        r.recover()
+        ca = canonical_carry(r.executor.carry)
+        cb = canonical_carry(golden.executor.carry)
+        for xa, xb in zip(jax.tree_util.tree_leaves(ca),
+                          jax.tree_util.tree_leaves(cb)):
+            np.testing.assert_array_equal(np.asarray(xa), np.asarray(xb))
+        print(f"  subtask {flat}: bit-identical on TPU", flush=True)
+
+
+def main():
+    check("pallas_histogram_on_chip", pallas_histogram_on_chip)
+    check("recovery_per_vertex_class_on_chip",
+          recovery_per_vertex_class_on_chip)
+    print("ALL TPU CHECKS PASSED")
+
+
+if __name__ == "__main__":
+    main()
