@@ -2,25 +2,57 @@
 
 Capability analog of the reference's source connectors
 (flink-connectors — Kafka FlinkKafkaConsumer et al.): a *rewindable,
-partitioned* record feed. The two operations mirror the exactly-once
-contract the Kafka consumer gives Flink:
+partitioned, bounded-retention* record feed. The operations mirror the
+exactly-once contract the Kafka consumer gives Flink:
 
 - ``pull(subtask, max_n)``        — live path: take up to ``max_n`` records
                                     from the subtask's partition cursor.
+- ``pull_block(subtask, b, k)``   — live hot path: k steps' worth of
+                                    pulls in one call, returned as dense
+                                    [k, b] arrays (the executor's block
+                                    program ingests whole blocks; a
+                                    per-step per-subtask Python loop was
+                                    the ingestion throughput cap).
 - ``read_at(subtask, offset, n)`` — recovery path: re-read an exact range
                                     (offsets restored from the checkpointed
                                     HostFeedSource state; per-step counts
                                     pinned by BUFFER_BUILT determinants).
+- ``notify_checkpoint_complete``  — durability hook: offsets up to the
+                                    completed checkpoint are *committed*
+                                    (FlinkKafkaConsumerBase
+                                    .notifyCheckpointComplete pattern);
+                                    the reader may release retention
+                                    below them, bounding memory.
 
-Readers return ``(keys, values)`` int lists. Timestamps are stamped by the
-operator from causal time, so feeds stay replay-exact.
+Retention is bounded, as in a real broker: each partition tracks a
+``base`` offset below which records are gone. Reading below base raises
+:class:`RetentionExpiredError` — loudly, at the exact offset — never a
+silent wrong answer. Recovery re-reads only from the latest *completed*
+checkpoint's offsets, so committing retention at checkpoint completion
+is always safe; an over-aggressive ``retention`` cap (records dropped
+before any checkpoint committed them) surfaces as this error at
+recovery time, exactly like a Kafka consumer falling behind a topic's
+retention window.
+
+Readers return ``(keys, values)`` int lists (or [k, b] int32 arrays from
+``pull_block``). Timestamps are stamped by the operator from causal
+time, so feeds stay replay-exact.
 """
 
 from __future__ import annotations
 
 import socket
 import threading
-from typing import List, Sequence, Tuple
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class RetentionExpiredError(ValueError):
+    """A re-read landed below a partition's retention floor: the records
+    are gone (dropped by the retention cap before a checkpoint committed
+    past them). The reference hits the identical wall when a recovering
+    Kafka source's restored offset has aged out of the topic."""
 
 
 class FeedReader:
@@ -31,49 +63,182 @@ class FeedReader:
                 ) -> Tuple[List[int], List[int]]:
         raise NotImplementedError
 
+    def pull_block(self, subtask: int, batch: int, k: int
+                   ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """k consecutive pulls as dense arrays: (keys [k, batch] int32,
+        values [k, batch] int32, counts [k] int32). Default: loop over
+        :meth:`pull`; array-backed readers override with a slice."""
+        ks = np.zeros((k, batch), np.int32)
+        vs = np.zeros((k, batch), np.int32)
+        counts = np.zeros((k,), np.int32)
+        for i in range(k):
+            kk, vv = self.pull(subtask, batch)
+            n = len(kk)
+            ks[i, :n], vs[i, :n], counts[i] = kk, vv, n
+        return ks, vs, counts
+
+    def notify_checkpoint_complete(self, offsets: Sequence[int]) -> None:
+        """Offsets[subtask] are durably checkpointed: recovery will never
+        re-read below them. Default: no-op (infinite retention)."""
+
+
+def _floor_check(base: int, subtask: int, offset: int) -> None:
+    if offset < base:
+        raise RetentionExpiredError(
+            f"partition {subtask}: offset {offset} is below the "
+            f"retention floor {base} — records expired before a "
+            f"checkpoint committed past them")
+
+
+class _RetainedPartitions:
+    """Shared bounded-retention core: per-partition record storage with a
+    base offset; all offsets are absolute (monotone across truncation)."""
+
+    def __init__(self, num_parts: int, retention: Optional[int]):
+        self._parts: List[List[Tuple[int, int]]] = [
+            [] for _ in range(num_parts)]
+        self._base = [0] * num_parts
+        self._cursor = [0] * num_parts
+        self.retention = retention
+
+    def _check_floor(self, subtask: int, offset: int) -> None:
+        _floor_check(self._base[subtask], subtask, offset)
+
+    def _slice(self, subtask: int, offset: int, n: int, exact: bool):
+        self._check_floor(subtask, offset)
+        lo = offset - self._base[subtask]
+        chunk = self._parts[subtask][lo: lo + n]
+        if exact and len(chunk) != n:
+            raise ValueError(
+                f"feed partition {subtask} cannot serve [{offset}, "
+                f"{offset + n}): only {len(chunk)} records available")
+        return chunk
+
+    def truncate_below(self, subtask: int, offset: int) -> None:
+        drop = offset - self._base[subtask]
+        if drop > 0:
+            del self._parts[subtask][:drop]
+            self._base[subtask] = offset
+
+    def _enforce_retention(self, subtask: int) -> None:
+        # Kafka-style size bound: only the newest `retention` records per
+        # partition survive, consumed or not.
+        if self.retention is None:
+            return
+        excess = len(self._parts[subtask]) - self.retention
+        if excess > 0:
+            self.truncate_below(subtask, self._base[subtask] + excess)
+
+    def commit(self, offsets: Sequence[int]) -> None:
+        for s, off in enumerate(offsets):
+            # Never raise the floor above consumption: the committed
+            # offset bounds replays, the cursor bounds live progress.
+            self.truncate_below(s, min(int(off), self._cursor[s]))
+
 
 class ListFeedReader(FeedReader):
-    """In-memory partitioned feed (tests / bounded replays). Retains all
-    records, so any range can be re-read (a Kafka topic with infinite
-    retention)."""
+    """In-memory partitioned feed (tests / bounded replays), stored as
+    dense [N, 2] int32 arrays for the block fast path. The preloaded
+    list models a stream arriving over time, so a finite ``retention``
+    bounds records kept *behind the consumption cursor* (replayable
+    history), never unconsumed future records; ``retention=None`` keeps
+    everything (a topic with infinite retention)."""
 
     def __init__(self, partitions: Sequence[Sequence[Tuple[int, int]]],
-                 records_per_pull: int = 1 << 30):
-        self._parts = [list(p) for p in partitions]
-        self._cursor = [0] * len(self._parts)
+                 records_per_pull: int = 1 << 30,
+                 retention: Optional[int] = None):
+        self._np = [np.asarray(list(p), np.int32).reshape(-1, 2)
+                    for p in partitions]
+        self._base = [0] * len(self._np)
+        self._cursor = [0] * len(self._np)
+        self.retention = retention
         self.records_per_pull = records_per_pull
 
-    def pull(self, subtask: int, max_n: int):
+    def _check_floor(self, subtask: int, offset: int) -> None:
+        _floor_check(self._base[subtask], subtask, offset)
+
+    def _trim_to(self, subtask: int, floor: int) -> None:
+        drop = floor - self._base[subtask]
+        if drop > 0:
+            self._np[subtask] = self._np[subtask][drop:]
+            self._base[subtask] = floor
+
+    def _trim_retention(self, subtask: int) -> None:
+        if self.retention is not None:
+            self._trim_to(subtask,
+                          self._cursor[subtask] - self.retention)
+
+    def _advance(self, subtask: int, n_max: int) -> np.ndarray:
         lo = self._cursor[subtask]
-        n = min(max_n, self.records_per_pull,
-                len(self._parts[subtask]) - lo)
-        self._cursor[subtask] = lo + n
-        chunk = self._parts[subtask][lo: lo + n]
-        return [k for k, _ in chunk], [v for _, v in chunk]
+        self._check_floor(subtask, lo)
+        rel = lo - self._base[subtask]
+        chunk = self._np[subtask][rel: rel + n_max]
+        self._cursor[subtask] = lo + len(chunk)
+        self._trim_retention(subtask)
+        return chunk
+
+    def pull(self, subtask: int, max_n: int):
+        chunk = self._advance(subtask,
+                              min(max_n, self.records_per_pull))
+        return chunk[:, 0].tolist(), chunk[:, 1].tolist()
+
+    def pull_block(self, subtask: int, batch: int, k: int):
+        per = min(batch, self.records_per_pull)
+        flat = self._advance(subtask, k * per)
+        take = len(flat)
+        ks = np.zeros((k, batch), np.int32)
+        vs = np.zeros((k, batch), np.int32)
+        counts = np.zeros((k,), np.int32)
+        full = take // per
+        counts[:full] = per
+        if full:
+            blk = flat[: full * per].reshape(full, per, 2)
+            ks[:full, :per] = blk[:, :, 0]
+            vs[:full, :per] = blk[:, :, 1]
+        tail = take - full * per
+        if tail and full < k:
+            counts[full] = tail
+            ks[full, :tail] = flat[full * per:, 0]
+            vs[full, :tail] = flat[full * per:, 1]
+        return ks, vs, counts
 
     def read_at(self, subtask: int, offset: int, n: int):
-        chunk = self._parts[subtask][offset: offset + n]
+        self._check_floor(subtask, offset)
+        rel = offset - self._base[subtask]
+        chunk = self._np[subtask][rel: rel + n]
         if len(chunk) != n:
             raise ValueError(
                 f"feed partition {subtask} cannot re-serve [{offset}, "
                 f"{offset + n}): retention too short")
-        return [k for k, _ in chunk], [v for _, v in chunk]
+        return chunk[:, 0].tolist(), chunk[:, 1].tolist()
+
+    def notify_checkpoint_complete(self, offsets: Sequence[int]) -> None:
+        for s, off in enumerate(offsets):
+            # Never drop past what's been consumed: the committed offset
+            # bounds replays, the cursor bounds live progress.
+            self._trim_to(s, min(int(off), self._cursor[s]))
 
 
 class SocketFeedReader(FeedReader):
     """Line-based TCP ingestion (the SocketWindowWordCount front door,
     reference flink-examples .../socket/SocketWindowWordCount.java). A
-    background thread drains the socket into an in-memory retained buffer
-    per subtask (single-partition: subtask 0), so the rewindable contract
-    still holds for ranges within retention.
+    background thread drains the socket into a bounded retained buffer
+    per subtask, so the rewindable contract holds for ranges within
+    retention and memory stays bounded for long-running feeds
+    (``retention`` records per partition; committed offsets release
+    earlier ones at every completed checkpoint).
 
     Lines are ``key[:value]`` integer pairs; value defaults to 1.
     """
 
-    def __init__(self, host: str, port: int, num_subtasks: int = 1):
-        self._buf: List[List[Tuple[int, int]]] = [
-            [] for _ in range(num_subtasks)]
-        self._cursor = [0] * num_subtasks
+    def __init__(self, host: str, port: int, num_subtasks: int = 1,
+                 retention: Optional[int] = 1 << 20):
+        self._r = _RetainedPartitions(num_subtasks, retention)
+        #: records dropped by retention before the consumer reached them
+        #: (the consumer fell behind the window; live pulls skip forward —
+        #: Kafka's auto.offset.reset=earliest — but the loss is counted,
+        #: never silent).
+        self.records_lost = [0] * num_subtasks
         self._lock = threading.Lock()
         self._sock = socket.create_connection((host, port))
         self._thread = threading.Thread(target=self._drain, daemon=True)
@@ -82,6 +247,7 @@ class SocketFeedReader(FeedReader):
     def _drain(self):
         f = self._sock.makefile("r")
         i = 0
+        nparts = len(self._r._parts)
         for line in f:
             line = line.strip()
             if not line:
@@ -94,21 +260,33 @@ class SocketFeedReader(FeedReader):
                     rec = (int(line), 1)
             except ValueError:
                 continue
+            s = i % nparts
             with self._lock:
-                self._buf[i % len(self._buf)].append(rec)
+                self._r._parts[s].append(rec)
+                self._r._enforce_retention(s)
             i += 1
 
     def pull(self, subtask: int, max_n: int):
         with self._lock:
-            lo = self._cursor[subtask]
-            chunk = self._buf[subtask][lo: lo + max_n]
-            self._cursor[subtask] = lo + len(chunk)
+            r = self._r
+            lo = r._cursor[subtask]
+            if lo < r._base[subtask]:
+                # Fell behind the retention window: the records are gone.
+                # Resume at the earliest retained offset and account for
+                # the gap (recovery re-reads via read_at still fail loud).
+                self.records_lost[subtask] += r._base[subtask] - lo
+                lo = r._base[subtask]
+            avail = r._base[subtask] + len(r._parts[subtask]) - lo
+            n = min(max_n, avail)
+            chunk = r._slice(subtask, lo, n, exact=True)
+            r._cursor[subtask] = lo + n
         return [k for k, _ in chunk], [v for _, v in chunk]
 
     def read_at(self, subtask: int, offset: int, n: int):
         with self._lock:
-            chunk = self._buf[subtask][offset: offset + n]
-        if len(chunk) != n:
-            raise ValueError(
-                f"socket feed cannot re-serve [{offset}, {offset + n})")
+            chunk = self._r._slice(subtask, offset, n, exact=True)
         return [k for k, _ in chunk], [v for _, v in chunk]
+
+    def notify_checkpoint_complete(self, offsets: Sequence[int]) -> None:
+        with self._lock:
+            self._r.commit(offsets)

@@ -153,6 +153,12 @@ class ClusterRunner:
             self.standbys.on_completed_checkpoint)
         self.coordinator.subscribe_completion(
             self.executor.notify_checkpoint_complete)
+        # Durable-connector contract: a completed checkpoint commits the
+        # feed offsets it captured (FlinkKafkaConsumerBase
+        # .notifyCheckpointComplete), letting bounded-retention readers
+        # release history below them — recovery only ever re-reads from
+        # the latest completed checkpoint's offsets.
+        self.coordinator.subscribe_completed_state(self._commit_feed_offsets)
         self.heartbeats = HeartbeatMonitor(
             range(job.total_subtasks()), timeout_s=heartbeat_timeout_s)
         self.failed: Set[int] = set()
@@ -222,6 +228,11 @@ class ClusterRunner:
             self.executor.compiled.log_capacity // DETS_PER_STEP)
         if prewarm:
             self.prewarm_recovery()
+
+    def _commit_feed_offsets(self, ckpt) -> None:
+        for vid, reader in self.executor.feed_readers.items():
+            off = np.asarray(ckpt.carry.op_states[vid]["offset"])
+            reader.notify_checkpoint_complete([int(x) for x in off])
 
     def _absorb_sink_outputs(self, outs, epoch: int) -> None:
         for vid, tl in self.txn_logs.items():

@@ -668,13 +668,12 @@ class LocalExecutor:
                 continue
             rows_k = np.zeros((k, v.parallelism, b), np.int32)
             rows_v = np.zeros((k, v.parallelism, b), np.int32)
-            valid = np.zeros((k, v.parallelism, b), bool)
-            for i in range(k):
-                for s in range(v.parallelism):
-                    ks, vs = reader.pull(s, b)
-                    n = len(ks)
-                    rows_k[i, s, :n], rows_v[i, s, :n] = ks, vs
-                    valid[i, s, :n] = True
+            counts = np.zeros((k, v.parallelism), np.int32)
+            for s in range(v.parallelism):
+                ks, vs, cnt = reader.pull_block(s, b, k)
+                rows_k[:, s, :], rows_v[:, s, :] = ks, vs
+                counts[:, s] = cnt
+            valid = np.arange(b)[None, None, :] < counts[:, :, None]
             feeds.append(RecordBatch(
                 jnp.asarray(rows_k), jnp.asarray(rows_v),
                 jnp.zeros((k, v.parallelism, b), jnp.int32),

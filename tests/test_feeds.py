@@ -1,0 +1,128 @@
+"""Durable-connector semantics: bounded retention, committed offsets,
+and the block-pull fast path (reference flink-connectors Kafka consumer:
+offsets in checkpoints, committed on completion, reads below the
+topic's retention window fail)."""
+
+import numpy as np
+import pytest
+
+from clonos_tpu.api.feeds import (FeedReader, ListFeedReader,
+                                  RetentionExpiredError)
+
+
+def _mk(n=64, parts=2, seed=0):
+    rng = np.random.RandomState(seed)
+    return [[(int(k), int(v)) for k, v in
+             zip(rng.randint(0, 100, n), rng.randint(0, 100, n))]
+            for _ in range(parts)]
+
+
+class _LoopReader(FeedReader):
+    """Reference semantics: the base-class pull_block loop over pull."""
+
+    def __init__(self, parts, rpp):
+        self._inner = ListFeedReader(parts, records_per_pull=rpp)
+
+    def pull(self, subtask, max_n):
+        return self._inner.pull(subtask, max_n)
+
+
+@pytest.mark.parametrize("rpp,b,k", [(1 << 30, 8, 4), (3, 8, 4),
+                                     (8, 8, 16), (5, 7, 9)])
+def test_pull_block_matches_pull_loop(rpp, b, k):
+    parts = _mk(n=50)
+    fast = ListFeedReader(parts, records_per_pull=rpp)
+    slow = _LoopReader(parts, rpp)
+    for _ in range(3):                       # cross partition exhaustion
+        for s in range(2):
+            fk, fv, fc = fast.pull_block(s, b, k)
+            sk, sv, sc = slow.pull_block(s, b, k)
+            np.testing.assert_array_equal(fc, sc)
+            np.testing.assert_array_equal(fk, sk)
+            np.testing.assert_array_equal(fv, sv)
+
+
+def test_read_at_roundtrip_and_exhaustion():
+    parts = _mk(n=20)
+    r = ListFeedReader(parts)
+    ks, vs = r.pull(0, 12)
+    k2, v2 = r.read_at(0, 3, 6)
+    assert (k2, v2) == (ks[3:9], vs[3:9])
+    with pytest.raises(ValueError):
+        r.read_at(0, 15, 10)                 # past the end
+
+
+def test_retention_expires_consumed_history():
+    r = ListFeedReader(_mk(n=40), retention=8)
+    r.pull(0, 30)
+    # Within the window: replayable.
+    assert len(r.read_at(0, 25, 5)[0]) == 5
+    # Below the floor (30 - 8 = 22): loud, typed failure.
+    with pytest.raises(RetentionExpiredError):
+        r.read_at(0, 10, 5)
+    # Unconsumed future records are never dropped by retention.
+    ks, _ = r.pull(0, 10)
+    assert len(ks) == 10
+
+
+def test_commit_trims_and_is_bounded_by_cursor():
+    r = ListFeedReader(_mk(n=40))
+    r.pull(0, 10)
+    r.pull(1, 4)
+    # Commit offset 20 on part 1 while only 4 consumed: floor caps at 4.
+    r.notify_checkpoint_complete([8, 20])
+    with pytest.raises(RetentionExpiredError):
+        r.read_at(0, 7, 2)
+    assert len(r.read_at(0, 8, 2)[0]) == 2
+    assert len(r.read_at(1, 4, 3)[0]) == 3
+
+
+def test_runner_commits_offsets_on_checkpoint_complete():
+    from clonos_tpu.api.environment import StreamEnvironment
+    from clonos_tpu.runtime.cluster import ClusterRunner
+
+    P, B, SPE = 2, 4, 4
+    env = StreamEnvironment(name="feeds-commit", num_key_groups=8,
+                            default_edge_capacity=64)
+    (env.host_source(batch_size=B, parallelism=P)
+        .key_by().reduce(num_keys=13, parallelism=P).sink(parallelism=P))
+    job = env.build()
+    reader = ListFeedReader(_mk(n=4 * SPE * B, parts=P, seed=3),
+                            retention=1 << 20)
+    runner = ClusterRunner(job, steps_per_epoch=SPE, log_capacity=256,
+                           max_epochs=8, inflight_ring_steps=16, seed=11)
+    runner.executor.register_feed(0, reader)
+    runner.run_epoch(complete_checkpoint=True)
+    # The completed checkpoint captured offsets at the fence; the reader's
+    # retention floor advanced exactly to them.
+    assert reader._base == [SPE * B] * P
+    # Recovery after the commit still works: it re-reads only from the
+    # latest completed checkpoint, which is at/above the floor.
+    runner.run_epoch(complete_checkpoint=False)
+    runner.inject_failure([1])
+    report = runner.recover()
+    assert report.records_replayed > 0
+
+
+def test_recovery_past_expired_offsets_fails_loudly():
+    from clonos_tpu.api.environment import StreamEnvironment
+    from clonos_tpu.runtime.cluster import ClusterRunner
+
+    P, B, SPE = 2, 4, 4
+    env = StreamEnvironment(name="feeds-expired", num_key_groups=8,
+                            default_edge_capacity=64)
+    (env.host_source(batch_size=B, parallelism=P)
+        .key_by().reduce(num_keys=13, parallelism=P).sink(parallelism=P))
+    job = env.build()
+    # Retention far smaller than an epoch of records: the un-checkpointed
+    # epoch's history is gone by the time the failure needs it.
+    reader = ListFeedReader(_mk(n=4 * SPE * B, parts=P, seed=4),
+                            retention=2)
+    runner = ClusterRunner(job, steps_per_epoch=SPE, log_capacity=256,
+                           max_epochs=8, inflight_ring_steps=16, seed=12)
+    runner.executor.register_feed(0, reader)
+    runner.run_epoch(complete_checkpoint=True)
+    runner.run_epoch(complete_checkpoint=False)
+    runner.inject_failure([0])
+    with pytest.raises(RetentionExpiredError):
+        runner.recover()
