@@ -104,11 +104,11 @@ class _RetainedPartitions:
     def _check_floor(self, subtask: int, offset: int) -> None:
         _floor_check(self._base[subtask], subtask, offset)
 
-    def _slice(self, subtask: int, offset: int, n: int, exact: bool):
+    def _slice(self, subtask: int, offset: int, n: int):
         self._check_floor(subtask, offset)
         lo = offset - self._base[subtask]
         chunk = self._parts[subtask][lo: lo + n]
-        if exact and len(chunk) != n:
+        if len(chunk) != n:
             raise ValueError(
                 f"feed partition {subtask} cannot serve [{offset}, "
                 f"{offset + n}): only {len(chunk)} records available")
@@ -153,6 +153,10 @@ class ListFeedReader(FeedReader):
         self._cursor = [0] * len(self._np)
         self.retention = retention
         self.records_per_pull = records_per_pull
+        # notify_checkpoint_complete can arrive from the coordinator's
+        # async writer thread while the executor pulls on the main
+        # thread; trims and reads must not interleave.
+        self._lock = threading.Lock()
 
     def _check_floor(self, subtask: int, offset: int) -> None:
         _floor_check(self._base[subtask], subtask, offset)
@@ -169,13 +173,14 @@ class ListFeedReader(FeedReader):
                           self._cursor[subtask] - self.retention)
 
     def _advance(self, subtask: int, n_max: int) -> np.ndarray:
-        lo = self._cursor[subtask]
-        self._check_floor(subtask, lo)
-        rel = lo - self._base[subtask]
-        chunk = self._np[subtask][rel: rel + n_max]
-        self._cursor[subtask] = lo + len(chunk)
-        self._trim_retention(subtask)
-        return chunk
+        with self._lock:
+            lo = self._cursor[subtask]
+            self._check_floor(subtask, lo)
+            rel = lo - self._base[subtask]
+            chunk = self._np[subtask][rel: rel + n_max]
+            self._cursor[subtask] = lo + len(chunk)
+            self._trim_retention(subtask)
+            return chunk
 
     def pull(self, subtask: int, max_n: int):
         chunk = self._advance(subtask,
@@ -203,9 +208,10 @@ class ListFeedReader(FeedReader):
         return ks, vs, counts
 
     def read_at(self, subtask: int, offset: int, n: int):
-        self._check_floor(subtask, offset)
-        rel = offset - self._base[subtask]
-        chunk = self._np[subtask][rel: rel + n]
+        with self._lock:
+            self._check_floor(subtask, offset)
+            rel = offset - self._base[subtask]
+            chunk = self._np[subtask][rel: rel + n]
         if len(chunk) != n:
             raise ValueError(
                 f"feed partition {subtask} cannot re-serve [{offset}, "
@@ -213,10 +219,11 @@ class ListFeedReader(FeedReader):
         return chunk[:, 0].tolist(), chunk[:, 1].tolist()
 
     def notify_checkpoint_complete(self, offsets: Sequence[int]) -> None:
-        for s, off in enumerate(offsets):
-            # Never drop past what's been consumed: the committed offset
-            # bounds replays, the cursor bounds live progress.
-            self._trim_to(s, min(int(off), self._cursor[s]))
+        with self._lock:
+            for s, off in enumerate(offsets):
+                # Never drop past what's been consumed: the committed
+                # offset bounds replays, the cursor bounds live progress.
+                self._trim_to(s, min(int(off), self._cursor[s]))
 
 
 class SocketFeedReader(FeedReader):
@@ -278,13 +285,13 @@ class SocketFeedReader(FeedReader):
                 lo = r._base[subtask]
             avail = r._base[subtask] + len(r._parts[subtask]) - lo
             n = min(max_n, avail)
-            chunk = r._slice(subtask, lo, n, exact=True)
+            chunk = r._slice(subtask, lo, n)
             r._cursor[subtask] = lo + n
         return [k for k, _ in chunk], [v for _, v in chunk]
 
     def read_at(self, subtask: int, offset: int, n: int):
         with self._lock:
-            chunk = self._r._slice(subtask, offset, n, exact=True)
+            chunk = self._r._slice(subtask, offset, n)
         return [k for k, _ in chunk], [v for _, v in chunk]
 
     def notify_checkpoint_complete(self, offsets: Sequence[int]) -> None:

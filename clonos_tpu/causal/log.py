@@ -306,6 +306,10 @@ class ThreadCausalLog:
         return np.asarray(buf)[: int(count)]
 
     @property
+    def capacity(self) -> int:
+        return self.state.capacity
+
+    @property
     def head(self) -> int:
         return int(self.state.head)
 

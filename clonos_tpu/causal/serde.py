@@ -60,7 +60,13 @@ Delta = Tuple[int, int, np.ndarray]
 def encode_delta(deltas: Sequence[Delta], encoding: str = "flat",
                  subtasks_per_vertex: int = 1) -> bytes:
     """Serialize per-log fresh suffixes into one wire frame."""
-    enc = FLAT if encoding == "flat" else GROUPED
+    if encoding == "flat":
+        enc = FLAT
+    elif encoding == "grouped":
+        enc = GROUPED
+    else:
+        raise ValueError(f"unknown delta encoding {encoding!r} "
+                         f"(expected 'flat' or 'grouped')")
     out = [_HDR.pack(MAGIC, enc, len(deltas))]
     if enc == FLAT:
         from clonos_tpu.ops import native

@@ -41,9 +41,18 @@ def _load():
         try:
             if (not os.path.exists(so)
                     or os.path.getmtime(so) < os.path.getmtime(src)):
-                subprocess.run(
-                    ["c++", "-O3", "-shared", "-fPIC", "-o", so, src],
-                    check=True, capture_output=True, timeout=120)
+                # Build to a private temp path, then atomically publish:
+                # concurrent processes (pytest-xdist, the two-process
+                # remote tests) must never dlopen a half-written ELF.
+                tmp = f"{so}.tmp.{os.getpid()}"
+                try:
+                    subprocess.run(
+                        ["c++", "-O3", "-shared", "-fPIC", "-o", tmp, src],
+                        check=True, capture_output=True, timeout=120)
+                    os.replace(tmp, so)
+                finally:
+                    if os.path.exists(tmp):
+                        os.unlink(tmp)
             lib = ctypes.CDLL(so)
             lib.dc_crc32.restype = ctypes.c_uint32
             lib.dc_crc32.argtypes = [ctypes.c_void_p, ctypes.c_uint64]

@@ -109,14 +109,30 @@ class ControlServer:
 
 
 class ControlClient:
-    """Blocking request/response client for a ControlServer."""
+    """Blocking request/response client for a ControlServer.
+
+    A failed call leaves the stream with a possibly half-read response,
+    so the socket is dropped on ANY transport error and transparently
+    re-established on the next call — callers retry calls, never manage
+    connections (an Akka RPC client reconnects the same way)."""
 
     def __init__(self, address: Tuple[str, int], timeout_s: float = 10.0):
-        self._sock = socket.create_connection(address, timeout=timeout_s)
+        self._address = tuple(address)
+        self._timeout = timeout_s
+        self._sock: Optional[socket.socket] = socket.create_connection(
+            self._address, timeout=timeout_s)
 
     def call(self, mtype: int, payload: bytes = b"") -> Tuple[int, bytes]:
-        _send(self._sock, mtype, payload)
-        return _recv(self._sock)
+        try:
+            if self._sock is None:
+                self._sock = socket.create_connection(
+                    self._address, timeout=self._timeout)
+            _send(self._sock, mtype, payload)
+            return _recv(self._sock)
+        except OSError:
+            self.close()
+            self._sock = None
+            raise
 
     def call_json(self, mtype: int, obj: Any) -> Any:
         rt, rp = self.call(mtype, pack_json(obj))
@@ -125,7 +141,8 @@ class ControlClient:
         return unpack_json(rp) if rp else None
 
     def close(self) -> None:
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass

@@ -94,17 +94,25 @@ class TaskExecutorClient:
         self._client = tp.ControlClient(tuple(jm_address))
         self._client.call_json(tp.REGISTER, {"executor_id": executor_id})
         self._interval = interval_s
+        #: consecutive heartbeat RPC failures (0 when healthy)
+        self.missed_beats = 0
         self._stop = threading.Event()
         self._t = threading.Thread(target=self._beat, daemon=True)
         self._t.start()
 
     def _beat(self) -> None:
+        # A transient socket error must not kill the heartbeat thread —
+        # a dead thread makes the JobMaster declare a HEALTHY executor
+        # failed after timeout_s (spurious failover). Keep trying; the
+        # JM's deadline is the arbiter of real failure, not one dropped
+        # RPC. ``missed_beats`` surfaces persistent trouble.
         while not self._stop.wait(self._interval):
             try:
                 self._client.call_json(tp.HEARTBEAT,
                                        {"executor_id": self.executor_id})
+                self.missed_beats = 0
             except (OSError, RuntimeError):
-                return
+                self.missed_beats += 1
 
     def close(self) -> None:
         self._stop.set()
@@ -143,7 +151,7 @@ class HostLogEndpoint:
         snap_starts: Dict[int, int] = {}
         for flat in range(rows.shape[0]):
             t, h = int(tails[flat]), int(heads[flat])
-            pos = [(t + i) & (cap - 1) for i in range(h - t)]
+            pos = np.arange(t, h) & (cap - 1)
             snap_rows[flat] = rows[flat][pos]
             snap_starts[flat] = t
         with self._lock:
@@ -157,18 +165,27 @@ class HostLogEndpoint:
         known = req.get("known_heads", {})
         encoding = req.get("encoding", "flat")
         deltas = []
+        floors: Dict[int, int] = {}
         with self._lock:
             for flat in req["flats"]:
                 rows = self._rows.get(flat)
                 if rows is None:
                     continue
                 start = self._starts[flat]
+                floors[flat] = start
                 lo = max(int(known.get(str(flat), -1)), start)
                 if lo - start >= rows.shape[0]:
                     continue
                 deltas.append((flat, lo, rows[lo - start:]))
         frame = serde.encode_delta(deltas, encoding=encoding)
-        return tp.DETERMINANT_RESPONSE, frame
+        # Response = u32 header length | JSON header | delta frame. The
+        # floors (each owner log's truncation point) let mirrors release
+        # rows below them — a remote notifyCheckpointComplete — so mirror
+        # memory tracks the owner's un-truncated window, not all history.
+        hdr = tp.pack_json({"floors": {str(f): v
+                                       for f, v in floors.items()}})
+        return (tp.DETERMINANT_RESPONSE,
+                len(hdr).to_bytes(4, "little") + hdr + frame)
 
     def close(self) -> None:
         self.server.close()
@@ -206,15 +223,23 @@ class RemoteReplicaMirror:
         mirror applies the same truncation: rebase to the delta's start
         and absorb from there (a remote notifyCheckpointComplete)."""
         known = {str(f): self.head(f) for f in self.flats}
-        rt, frame = self._client.call(tp.DETERMINANT_REQUEST, tp.pack_json(
+        rt, resp = self._client.call(tp.DETERMINANT_REQUEST, tp.pack_json(
             {"flats": self.flats, "known_heads": known,
              "encoding": self.encoding}))
         if rt == tp.ERROR:
-            raise RuntimeError(tp.unpack_json(frame)["error"])
+            raise RuntimeError(tp.unpack_json(resp)["error"])
+        hlen = int.from_bytes(resp[:4], "little")
+        floors = tp.unpack_json(resp[4: 4 + hlen]).get("floors", {})
+        frame = resp[4 + hlen:]
         absorbed = 0
         for flat, start, rows in serde.decode_delta(frame):
             log = self._replicas[flat]
             rows = np.asarray(rows, np.int32)
+            if rows.shape[0] > log.capacity:
+                raise RuntimeError(
+                    f"mirror of log {flat}: delta of {rows.shape[0]} rows "
+                    f"exceeds mirror capacity {log.capacity} — size the "
+                    f"mirror at least as large as the owner's log")
             if not log.merge_delta(rows, start):
                 log.state = log.state._replace(
                     head=jnp.asarray(start, jnp.int32),
@@ -224,6 +249,20 @@ class RemoteReplicaMirror:
                         f"mirror of log {flat}: delta rejected even "
                         f"after rebase to {start}")
             absorbed += rows.shape[0]
+        # Owner truncation points release mirror history (the remote
+        # checkpoint-complete); a mirror that STILL overflows is
+        # undersized for the owner's un-truncated window — corrupt ring
+        # state, so fail loudly instead of serving garbage to recovery.
+        for flat, log in self._replicas.items():
+            floor = int(floors.get(str(flat), log.tail))
+            if floor > log.tail:
+                log.state = log.state._replace(
+                    tail=jnp.asarray(floor, jnp.int32))
+            if int(log.head) - int(log.tail) > log.capacity:
+                raise RuntimeError(
+                    f"mirror of log {flat}: {int(log.head) - int(log.tail)}"
+                    f" live rows exceed capacity {log.capacity}; increase "
+                    f"mirror capacity or checkpoint more often")
         return absorbed
 
     def close(self) -> None:

@@ -176,8 +176,10 @@ def test_mirror_rebases_across_owner_truncation():
 
         def _handle(self, mtype, payload):
             start, rows = self.script.pop(0)
-            return tp.DETERMINANT_RESPONSE, sd.encode_delta(
-                [(1, start, rows)])
+            hdr = tp.pack_json({"floors": {"1": start}})
+            return tp.DETERMINANT_RESPONSE, (
+                len(hdr).to_bytes(4, "little") + hdr
+                + sd.encode_delta([(1, start, rows)]))
 
     ep = FakeEndpoint()
     rows1 = np.arange(24, dtype=np.int32).reshape(3, 8)
@@ -191,5 +193,49 @@ def test_mirror_rebases_across_owner_truncation():
     assert m.sync() == 2                 # gap -> rebase to 10, absorb
     assert m.head(1) == 12
     np.testing.assert_array_equal(m.rows(1), rows2)
+    ep.server.close()
+    m.close()
+
+
+def test_mirror_releases_history_at_floor_and_fails_loud_when_undersized():
+    """The response's floors (owner truncation points) bound mirror
+    memory: rows below them are released — the remote checkpoint-
+    complete. A mirror too small for the owner's un-truncated window
+    raises instead of wrapping its ring into garbage (review finding)."""
+    import numpy as np
+    from clonos_tpu.parallel import transport as tp
+    from clonos_tpu.causal import serde as sd
+    from clonos_tpu.runtime.remote import RemoteReplicaMirror
+
+    class FakeEndpoint:
+        def __init__(self):
+            self.script = []
+            self.server = tp.ControlServer(self._handle)
+            self.address = self.server.address
+
+        def _handle(self, mtype, payload):
+            floor, start, rows = self.script.pop(0)
+            hdr = tp.pack_json({"floors": {"1": floor}})
+            return tp.DETERMINANT_RESPONSE, (
+                len(hdr).to_bytes(4, "little") + hdr
+                + sd.encode_delta([(1, start, rows)]))
+
+    ep = FakeEndpoint()
+    mk = lambda n, off: (np.arange(n * 8, dtype=np.int32).reshape(n, 8)
+                         + off)
+    # Round 1: 6 rows from offset 0, owner floor 0. Round 2: 6 more,
+    # owner has truncated below 6 -> mirror releases [0, 6).
+    ep.script = [(0, 0, mk(6, 0)), (6, 6, mk(6, 100))]
+    m = RemoteReplicaMirror(ep.address, flats=[1], capacity=8,
+                            max_epochs=8)
+    assert m.sync() == 6
+    assert m.sync() == 6
+    assert m.head(1) == 12
+    np.testing.assert_array_equal(m.rows(1), mk(6, 100))  # floor applied
+    # Round 3: owner did NOT truncate (floor stays 6) and serves 6 more:
+    # 12 live rows > capacity 8 -> loud failure, not ring corruption.
+    ep.script = [(6, 12, mk(6, 200))]
+    with pytest.raises(RuntimeError, match="exceed capacity"):
+        m.sync()
     ep.server.close()
     m.close()
