@@ -278,6 +278,25 @@ class StaticRoutePlan:
         return routed, dropped
 
 
+def _static_targets(slot_keys: np.ndarray, parallelism: int,
+                    num_key_groups: int) -> np.ndarray:
+    """Target subtask of each static slot key — THE key->key-group->
+    subtask map; every compile-time consumer must share this one copy."""
+    kg = (hash32_np(slot_keys) % num_key_groups).astype(np.int64)
+    return (kg * parallelism) // num_key_groups
+
+
+def static_hash_capacity(slot_keys: np.ndarray, src_parallelism: int,
+                         parallelism: int, num_key_groups: int) -> int:
+    """Smallest per-target receive capacity for which
+    :func:`plan_static_hash` has no overflow (drop) slots: the densest
+    target's key count times the producer parallelism."""
+    slot_keys = np.asarray(slot_keys, np.int64)
+    tgt = _static_targets(slot_keys, parallelism, num_key_groups)
+    return int(np.bincount(tgt, minlength=parallelism).max()) \
+        * src_parallelism
+
+
 def plan_static_hash(slot_keys: np.ndarray, src_parallelism: int,
                      parallelism: int, num_key_groups: int,
                      out_capacity: int) -> StaticRoutePlan:
@@ -285,8 +304,7 @@ def plan_static_hash(slot_keys: np.ndarray, src_parallelism: int,
     emits key ``slot_keys[i]`` in slot ``i`` on every subtask."""
     slot_keys = np.asarray(slot_keys, np.int64)
     B = slot_keys.shape[0]
-    kg = (hash32_np(slot_keys) % num_key_groups).astype(np.int64)
-    tgt = (kg * parallelism) // num_key_groups
+    tgt = _static_targets(slot_keys, parallelism, num_key_groups)
     T, cap = parallelism, out_capacity
     src_p = np.zeros((T, cap), np.int32)
     src_slot = np.zeros((T, cap), np.int32)

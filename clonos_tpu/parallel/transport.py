@@ -119,10 +119,13 @@ class ControlClient:
     def __init__(self, address: Tuple[str, int], timeout_s: float = 10.0):
         self._address = tuple(address)
         self._timeout = timeout_s
+        self._closed = False
         self._sock: Optional[socket.socket] = socket.create_connection(
             self._address, timeout=timeout_s)
 
     def call(self, mtype: int, payload: bytes = b"") -> Tuple[int, bytes]:
+        if self._closed:
+            raise RuntimeError("ControlClient is closed")
         try:
             if self._sock is None:
                 self._sock = socket.create_connection(
@@ -130,8 +133,7 @@ class ControlClient:
             _send(self._sock, mtype, payload)
             return _recv(self._sock)
         except OSError:
-            self.close()
-            self._sock = None
+            self._drop()
             raise
 
     def call_json(self, mtype: int, obj: Any) -> Any:
@@ -140,9 +142,16 @@ class ControlClient:
             raise RuntimeError(unpack_json(rp)["error"])
         return unpack_json(rp) if rp else None
 
-    def close(self) -> None:
+    def _drop(self) -> None:
+        # A failed call may leave a half-read response; never reuse the
+        # stream — the next call reconnects fresh.
         if self._sock is not None:
             try:
                 self._sock.close()
             except OSError:
                 pass
+            self._sock = None
+
+    def close(self) -> None:
+        self._closed = True
+        self._drop()

@@ -176,16 +176,32 @@ class CompiledJob:
             if e.partition != PartitionType.HASH:
                 continue
             sk = self.job.vertices[e.src].operator.static_out_keys()
-            if sk is not None:
-                plan = routing.plan_static_hash(
-                    sk, self.job.vertices[e.src].parallelism,
-                    self.job.vertices[e.dst].parallelism,
-                    self.job.num_key_groups, e.capacity)
-                # A plan with overflow slots would drop those records on
-                # EVERY step (the dynamic exchange drops only per-step
-                # excess arrivals) — keep the dynamic semantics then.
-                if len(plan.drop_p) == 0:
-                    self.static_route[eidx] = plan
+            if sk is None:
+                continue
+            src_p = self.job.vertices[e.src].parallelism
+            dst_p = self.job.vertices[e.dst].parallelism
+            # The static plan reserves a slot for EVERY (producer, key)
+            # pair, so a hash-skewed target can need more than the
+            # requested receive window even though the dynamic exchange
+            # never drops (it only sees per-step live arrivals). The
+            # edge capacity is a lower-bound request — widen it to fit
+            # the densest target (rounded to the 128 TPU lane width):
+            # total extra memory is bounded by the hash imbalance times
+            # the producer's own output width, and it buys the gather
+            # plan (~50x cheaper than the sort exchange at bench shapes).
+            need = routing.static_hash_capacity(
+                sk, src_p, dst_p, self.job.num_key_groups)
+            if need > e.capacity:
+                e.capacity = -(-need // 128) * 128
+            plan = routing.plan_static_hash(
+                sk, src_p, dst_p, self.job.num_key_groups, e.capacity)
+            if len(plan.drop_p):                       # pragma: no cover
+                raise RuntimeError(
+                    f"static plan for edge {eidx} still has "
+                    f"{len(plan.drop_p)} overflow slots at capacity "
+                    f"{e.capacity} — static_hash_capacity disagrees "
+                    f"with plan_static_hash")
+            self.static_route[eidx] = plan
 
     def consumer_slot_keys(self, vid: int) -> Optional[np.ndarray]:
         """Static per-slot input keys of vertex ``vid`` ([P, cap], -1 =
