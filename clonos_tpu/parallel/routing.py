@@ -96,10 +96,9 @@ def _scatter_to_targets(
     return zero_invalid(out), dropped
 
 
-#: per-step batches >= this flat size route through one block-wide sort;
-#: smaller ones keep K vmapped sorts (faster: XLA batches small sort
-#: networks across steps — tools/profile_block.py)
-_FLAT_SORT_MIN_N = 4096
+#: cap on the counting exchange's [K, n, T+1] cumsum scratch; bigger
+#: routes fall back to the flat sort (memory, not speed, is the bound).
+_COUNT_ROUTE_MAX_BYTES = 256 << 20
 
 
 def _block_to_targets(
@@ -107,57 +106,79 @@ def _block_to_targets(
     out_capacity: int
 ) -> Tuple[RecordBatch, jnp.ndarray]:
     """Block-form exchange: route a whole ``[K, P, B]`` stack of per-step
-    batches in ONE sort instead of K vmapped sorts.
+    batches without sorting at all.
 
-    Composite sort key = ``step * (T+1) + target`` (invalid records get
-    target T): one stable flat argsort of ``K*P*B`` int32 keys groups
-    records by (step, target) while preserving arrival order within each
-    group — bit-identical to vmapping :func:`_scatter_to_targets` per step.
-    Placement is then a *gather* ``out[k, t, c] = sorted[run_start[k,t]+c]``
-    (run starts via searchsorted), which the TPU executes as fast vector
-    loads — unlike the per-step scatter this replaces, which XLA
-    serializes. ~5x faster at bench shapes (tools/ab_kernels2.py).
+    A record's slot within its target is its *arrival rank*: the count of
+    same-target records before it in (p-major, slot) order. With T
+    targets that is a running per-bucket count — one cumsum over a
+    ``[K, n, T+1]`` one-hot (invalid records get bucket T), no argsort.
+    The TPU executes the cumsum as a few vector passes where the sort
+    this replaced cost ~2x more at bench shapes (/tmp A/B, 49ms -> 24ms
+    per 512-step block); placement is then ONE flat scatter of the K*n
+    records into ``[K, T+1, cap]`` (the +1 row swallows drops).
+    Bit-identical to vmapping :func:`_scatter_to_targets` per step,
+    including overflow accounting (first ``cap`` arrivals per target
+    survive, the rest count as dropped).
 
-    Range guard: needs ``K * (T+1) < 2^31``; checked.
+    Routes whose cumsum scratch would exceed ``_COUNT_ROUTE_MAX_BYTES``
+    (huge T) fall back to one block-wide composite-key sort
+    (``step * (T+1) + target``, stable) with gather placement.
     """
     K, P, B = batch.keys.shape
     T = num_targets
     n = P * B
-    if n >= _FLAT_SORT_MIN_N:
-        # One flat sort over the whole block (amortizes best when each
-        # step's batch is large).
-        if K * (T + 1) >= (1 << 31):
-            raise ValueError(f"composite sort key overflow: K={K} T={T}")
-        flat = lambda x: jnp.reshape(x, (K * n,))
-        keys, vals, ts, valid = map(flat, batch)
-        tgt = jnp.where(valid, flat(target), T)
-        step = jnp.repeat(jnp.arange(K, dtype=jnp.int32), n,
-                          total_repeat_length=K * n)
-        composite = step * (T + 1) + tgt
-        order = jnp.argsort(composite, stable=True)
-        sc = composite[order]
-        # Boundary of every (step, target) run: [K*(T+1)] starts.
-        bounds = jnp.arange(K * (T + 1), dtype=jnp.int32)
-        run_start = jnp.searchsorted(sc, bounds,
-                                     side="left").astype(jnp.int32)
-        run_end = jnp.concatenate(
-            [run_start[1:], jnp.asarray([K * n], jnp.int32)])
-        run_len = (run_end - run_start).reshape(K, T + 1)[:, :T]  # [K, T]
-        dropped = jnp.maximum(run_len - out_capacity, 0).astype(jnp.int32)
-        c = jnp.arange(out_capacity, dtype=jnp.int32)
-        src = run_start.reshape(K, T + 1)[:, :T, None] + c[None, None, :]
-        ok = (c[None, None, :]
-              < jnp.minimum(run_len, out_capacity)[:, :, None])
-        pick = order[jnp.clip(src, 0, K * n - 1)]                # [K, T, cap]
-        out = RecordBatch(keys[pick], vals[pick], ts[pick], ok)
+    # Price the ~3 concurrent [K, n, T+1] buffers this branch holds (the
+    # one-hot's int32 cast, the cumsum output, and one fusion temp), not
+    # just one — the cap must actually bound peak scratch.
+    if K * n * (T + 1) * 4 * 3 <= _COUNT_ROUTE_MAX_BYTES:
+        fl = lambda x: jnp.reshape(x, (K, n))
+        keys, vals, ts, valid = map(fl, batch)
+        tgt = jnp.where(valid, fl(target), T)
+        onehot = (tgt[:, :, None] ==
+                  jnp.arange(T + 1, dtype=jnp.int32)[None, None, :])
+        pos_all = jnp.cumsum(onehot.astype(jnp.int32), axis=1)
+        pos = jnp.take_along_axis(
+            pos_all, tgt[:, :, None], axis=2)[:, :, 0] - 1
+        counts = pos_all[:, -1, :T]
+        keep = (tgt < T) & (pos < out_capacity)
+        dropped = jnp.maximum(counts - out_capacity, 0).astype(jnp.int32)
+        row = jnp.where(keep, tgt, T)
+        col = jnp.where(keep, pos, 0)
+        kidx = jnp.arange(K, dtype=jnp.int32)[:, None]
+        shape = (K, T + 1, out_capacity)
+        mk = lambda src, z: jnp.zeros(shape, z).at[kidx, row, col].set(
+            src, mode="drop")
+        out = RecordBatch(mk(keys, jnp.int32), mk(vals, jnp.int32),
+                          mk(ts, jnp.int32), mk(keep, jnp.bool_))
+        out = RecordBatch(out.keys[:, :T], out.values[:, :T],
+                          out.timestamps[:, :T], out.valid[:, :T])
         return zero_invalid(out), dropped
-    # Small per-step batches: K vmapped sort+scatter exchanges vectorize
-    # better than one long sort run (XLA batches the small sort networks
-    # across the step axis, and dynamic gathers of [T*cap] from small rows
-    # are slower than the scatter here — tools/profile_block.py).
-    return jax.vmap(
-        lambda b, t: _scatter_to_targets(b, t, num_targets, out_capacity)
-    )(batch, target)
+    # Flat-sort fallback (huge T): one composite-key sort over the block.
+    if K * (T + 1) >= (1 << 31):
+        raise ValueError(f"composite sort key overflow: K={K} T={T}")
+    flat = lambda x: jnp.reshape(x, (K * n,))
+    keys, vals, ts, valid = map(flat, batch)
+    tgt = jnp.where(valid, flat(target), T)
+    step = jnp.repeat(jnp.arange(K, dtype=jnp.int32), n,
+                      total_repeat_length=K * n)
+    composite = step * (T + 1) + tgt
+    order = jnp.argsort(composite, stable=True)
+    sc = composite[order]
+    # Boundary of every (step, target) run: [K*(T+1)] starts.
+    bounds = jnp.arange(K * (T + 1), dtype=jnp.int32)
+    run_start = jnp.searchsorted(sc, bounds,
+                                 side="left").astype(jnp.int32)
+    run_end = jnp.concatenate(
+        [run_start[1:], jnp.asarray([K * n], jnp.int32)])
+    run_len = (run_end - run_start).reshape(K, T + 1)[:, :T]  # [K, T]
+    dropped = jnp.maximum(run_len - out_capacity, 0).astype(jnp.int32)
+    c = jnp.arange(out_capacity, dtype=jnp.int32)
+    src = run_start.reshape(K, T + 1)[:, :T, None] + c[None, None, :]
+    ok = (c[None, None, :]
+          < jnp.minimum(run_len, out_capacity)[:, :, None])
+    pick = order[jnp.clip(src, 0, K * n - 1)]                # [K, T, cap]
+    out = RecordBatch(keys[pick], vals[pick], ts[pick], ok)
+    return zero_invalid(out), dropped
 
 
 def route_hash(batch: RecordBatch, parallelism: int, num_key_groups: int,

@@ -120,6 +120,9 @@ class RecoveryReport:
     #: wall-clock per recovery phase (fetch_determinants / inputs / replay /
     #: patch / replica_rebuild) — the cold-recovery cost breakdown.
     phase_ms: Dict[str, float] = dataclasses.field(default_factory=dict)
+    #: True for failover rehearsals (failover_drill): excluded from the
+    #: recovery metrics and the reports ledger.
+    drill: bool = False
 
 
 class OverflowError_(RuntimeError):
@@ -566,11 +569,18 @@ class ClusterRunner:
     def detect_failures(self) -> List[int]:
         return self.heartbeats.expired()
 
-    def recover(self) -> RecoveryReport:
+    def recover(self, drill: bool = False) -> RecoveryReport:
         """Run the full causal-recovery protocol for all failed subtasks,
         in topological order (an upstream's reconstructed ring shard feeds
         its downstream's replay — the reference's staged
-        WaitingConnections/in-flight-request ordering)."""
+        WaitingConnections/in-flight-request ordering).
+
+        ``drill=True`` (failover rehearsal) runs the identical replay
+        protocol but makes none of the failure-handling *decisions* —
+        pending checkpoints are not ignored (they may yet complete),
+        no IGNORE_CHECKPOINT determinants are logged, the checkpoint
+        interval is not backed off, and recovered timer effects are not
+        re-fired — so the job state is bit-identical afterwards."""
         if not self.failed:
             raise rec.RecoveryError("no failed subtasks")
         if not self.standbys.has_state():
@@ -584,18 +594,20 @@ class ClusterRunner:
 
         # (1) RunStandbyTaskStrategy.onTaskFailure: ignore checkpoints the
         # dead tasks never acked; back off the checkpoint interval.
-        ignored = tuple(self.coordinator.ignore_unacked_for(set(failed)))
-        self.coordinator.backoff()
-        # Healthy tasks log the ignore decision (reference
-        # StreamTask.ignoreCheckpoint:891-915 — the RPC arrival is a
-        # determinant so their own later recoveries replay it).
-        healthy = [f for f in range(self.job.total_subtasks())
-                   if f not in self.failed]
-        for cid in ignored:
-            self.executor.append_async_many(
-                healthy, det.IgnoreCheckpointDeterminant(
-                    record_count=self.executor.global_record_stamp(),
-                    checkpoint_id=cid))
+        ignored: Tuple[int, ...] = ()
+        if not drill:
+            ignored = tuple(self.coordinator.ignore_unacked_for(set(failed)))
+            self.coordinator.backoff()
+            # Healthy tasks log the ignore decision (reference
+            # StreamTask.ignoreCheckpoint:891-915 — the RPC arrival is a
+            # determinant so their own later recoveries replay it).
+            healthy = [f for f in range(self.job.total_subtasks())
+                       if f not in self.failed]
+            for cid in ignored:
+                self.executor.append_async_many(
+                    healthy, det.IgnoreCheckpointDeterminant(
+                        record_count=self.executor.global_record_stamp(),
+                        checkpoint_id=cid))
 
         ckpt = self.standbys.latest
         from_epoch = ckpt.checkpoint_id + 1
@@ -722,7 +734,7 @@ class ClusterRunner:
             # into the rebuilt log; only the callback side-effects re-run —
             # reference LogReplayerImpl.triggerAsyncEvent:102).
             svc = self.timer_services.get(flat)
-            if svc is not None:
+            if svc is not None and not drill:
                 for _step_i, ad in result.async_events:
                     if isinstance(ad, det.TimerTriggerDeterminant):
                         svc.refire(ad)
@@ -784,17 +796,21 @@ class ClusterRunner:
         for flat in failed:
             self.heartbeats.revive(flat)
         self.failed.clear()
-        self.coordinator.reset_interval()
+        if not drill:
+            self.coordinator.reset_interval()
         report = RecoveryReport(
             failed_subtasks=failed, from_epoch=from_epoch,
             steps_replayed=n_steps, determinants_replayed=total_dets,
             records_replayed=total_records,
             ignored_checkpoints=ignored,
             recovery_ms=(_time.monotonic() - t0) * 1e3,
-            managers=tuple(managers), phase_ms=phases)
-        self.reports.append(report)
-        self._m_recovery_ms.update(report.recovery_ms)
-        self._m_recovered_records.inc(report.records_replayed)
+            managers=tuple(managers), phase_ms=phases, drill=drill)
+        if not drill:
+            # Rehearsals must not inflate the recovery count/latency
+            # series operators alert on.
+            self.reports.append(report)
+            self._m_recovery_ms.update(report.recovery_ms)
+            self._m_recovered_records.inc(report.records_replayed)
         return report
 
     def prewarm_recovery(self, vertex_ids: Optional[Sequence[int]] = None,
@@ -928,6 +944,62 @@ class ClusterRunner:
                                            carry.out_rings[ri]),
                     zero_batch((ch, out_cap)),
                     z, z, jnp.asarray(1, jnp.int32), z)
+        return _time.monotonic() - t0
+
+    def failover_drill(self, flats: Optional[Sequence[int]] = None
+                       ) -> float:
+        """Rehearse a failover end-to-end and return its wall-clock
+        seconds: inject a failure, run the full recovery protocol, and
+        rely on bit-identical recovery to leave the job state canonically
+        unchanged (executor.canonical_carry: live log/ring content equal;
+        physically-dead pre-fence slots may differ — nothing ever reads
+        them). The reference's RunStandbyTaskStrategy keeps standby
+        executions *running* (Task.java:300-302, Execution.java:373-377),
+        so their whole failure path is hot; compiling programs
+        (prewarm_recovery) is necessary but not sufficient for that — the
+        first execution still pays allocator growth, transfer-path and
+        host-pool warmup (~4x on a tunneled backend). One drill moves all
+        of it off the real failure path.
+
+        Default drill set: one subtask of every vertex class, failed
+        together (a connected multi-class failure exercises every class's
+        replay program and the staged topological recovery)."""
+        if self.failed:
+            raise rec.RecoveryError("cannot drill with real failures "
+                                    "pending")
+        if not self.standbys.has_state():
+            raise rec.RecoveryError(
+                "failover_drill needs a completed checkpoint")
+        t0 = _time.monotonic()
+        fence = self._fence_step[self.standbys.latest.checkpoint_id + 1]
+        if self.global_step == fence:
+            import warnings
+            warnings.warn(
+                "failover_drill at an epoch fence replays zero steps; "
+                "run it mid-epoch so the chunked replay path executes")
+        if flats is None:
+            flats = [self.job.subtask_base(v.vertex_id)
+                     for v in self.job.vertices]
+        flats = list(flats)
+        # The drill must NEVER corrupt a healthy job: verify every drilled
+        # log has a surviving replica holder BEFORE zeroing any device
+        # state (recover() makes the same check, but only after the
+        # injection has already destroyed the state it needs).
+        if self.global_step > fence:
+            fset = set(flats)
+            for flat in flats:
+                vid, _ = self._vertex_of(flat)
+                if not self.job.out_edges(vid):
+                    continue       # sinks synthesize; no holder needed
+                if not any(o == flat and h not in fset
+                           for (o, h) in self.plan.pairs):
+                    raise rec.RecoveryError(
+                        f"failover_drill: subtask {flat} would have no "
+                        f"surviving determinant replica under drill set "
+                        f"{sorted(fset)} — drill fewer subtasks at once "
+                        f"or deepen sharing/replication")
+        self.inject_failure(flats)
+        self.recover(drill=True)
         return _time.monotonic() - t0
 
     def _rebuild_txn_shards(self, vid: int, sub: int,
@@ -1290,14 +1362,18 @@ class ClusterRunner:
                 # from_epoch starts exactly at the checkpointed head (async
                 # rows appended in the roll gap come after the fence);
                 # later fences anchor at their first step's TIMESTAMP row
-                # (one-row skew if an async row landed in that roll gap —
-                # conservative side, matches round-1 semantics).
+                # minus the roll-gap ledger — async rows appended after
+                # the roll but before the epoch's first step (fence
+                # SOURCE_CHECKPOINTs, ignore broadcasts, between-epoch
+                # service calls) precede that anchor yet belong to the
+                # NEW epoch (executor.roll_gap_async).
+                gap = self.executor.roll_gap_async.get((flat, e), 0)
                 if step_i == 0:
                     off = ck_head
                 elif step_i < len(ts_pos):
-                    off = ck_head + int(ts_pos[step_i])
+                    off = ck_head + int(ts_pos[step_i]) - gap
                 else:
-                    off = ck_head + n
+                    off = ck_head + n - gap
                 epoch_offs[e % me] = off
                 epoch_mask[e % me] = True
                 latest = max(latest, e)

@@ -191,6 +191,13 @@ class CompiledJob:
             # plan (~50x cheaper than the sort exchange at bench shapes).
             need = routing.static_hash_capacity(
                 sk, src_p, dst_p, self.job.num_key_groups)
+            if need > max(4 * e.capacity, 1024):
+                # The static plan would need far more receive memory than
+                # the user asked for (very dense key table or extreme
+                # hash skew into a narrow edge): keep the dynamic
+                # exchange rather than silently multiplying the edge and
+                # downstream buffers.
+                continue
             if need > e.capacity:
                 e.capacity = -(-need // 128) * 128
             plan = routing.plan_static_hash(
@@ -543,6 +550,11 @@ class LocalExecutor:
         self._rng = np.random.RandomState(seed)
         self.epoch_id = 0
         self.step_in_epoch = 0
+        #: (flat, epoch) -> async rows appended in that epoch's roll gap
+        #: (after the roll, before its first step) — recovery subtracts
+        #: this when re-deriving epoch start offsets from TIMESTAMP
+        #: anchors (the rows belong to the NEW epoch).
+        self.roll_gap_async: Dict[Tuple[int, int], int] = {}
         #: supersteps actually executed (the staged epoch path pre-fills
         #: step_input_history, so len(history) over-counts mid-epoch).
         self._steps_executed = 0
@@ -837,6 +849,8 @@ class LocalExecutor:
         for i, pend in enumerate(self._pending_spill):
             self._pending_spill[i] = [(e, s, m) for (e, s, m) in pend
                                       if e > epoch]
+        self.roll_gap_async = {k: v for k, v in self.roll_gap_async.items()
+                               if k[1] > epoch}
 
     def _health_vector(self, carry: JobCarry) -> jnp.ndarray:
         """Pure: packed int32 [3 + num_rings + 1 + 1] health flags + total
@@ -925,6 +939,17 @@ class LocalExecutor:
         if row[det.LANE_RC] == 0 and row[det.LANE_TAG] in (det.TIMESTAMP,
                                                            det.RNG):
             row[det.LANE_RC] = self.global_record_stamp()
+        if self.step_in_epoch == 0:
+            # Roll-gap append: the epoch already rolled but none of its
+            # steps ran, so this row belongs to the NEW epoch even though
+            # it precedes the epoch's first TIMESTAMP anchor in the log.
+            # Recovery rebuilds the epoch->offset index from those anchors
+            # and subtracts this ledger to place the boundary exactly
+            # (cluster._patch; SOURCE_CHECKPOINT / IGNORE_CHECKPOINT /
+            # service calls between epochs all land here).
+            for f in flat_subtasks:
+                k = (f, self.epoch_id)
+                self.roll_gap_async[k] = self.roll_gap_async.get(k, 0) + 1
         rows1 = np.zeros((self.compiled.L, det.NUM_LANES), np.int32)
         counts = np.zeros((self.compiled.L,), np.int32)
         rows1[list(flat_subtasks)] = row

@@ -273,3 +273,70 @@ def test_heartbeat_detection():
     r2.heartbeats.timeout_s = 0.01
     time.sleep(0.05)
     assert 0 in r2.detect_failures()
+
+
+def test_failover_drill_leaves_state_identical():
+    """failover_drill runs a real multi-class recovery mid-epoch and must
+    leave the carry bit-identical (the rehearsal is free) — the standby
+    warm-path capability (RunStandbyTaskStrategy keeps standbys running;
+    here: every failure-path program and pool warmed by one drill)."""
+    import jax
+    import numpy as np
+    from clonos_tpu.api.environment import StreamEnvironment
+    from clonos_tpu.runtime.cluster import ClusterRunner
+
+    env = StreamEnvironment(name="drill", num_key_groups=8,
+                            default_edge_capacity=64)
+    (env.synthetic_source(vocab=13, batch_size=4, parallelism=2)
+        .key_by().window_count(num_keys=13, window_size=1 << 30,
+                               parallelism=2)
+        .key_by().reduce(num_keys=13, parallelism=2).sink(parallelism=2))
+    runner = ClusterRunner(env.build(), steps_per_epoch=4, log_capacity=256,
+                           max_epochs=8, inflight_ring_steps=16, seed=21)
+    from clonos_tpu.runtime.executor import canonical_carry
+    runner.run_epoch(complete_checkpoint=True)
+    runner.run_epoch(complete_checkpoint=False)   # mid-data: replay work
+    before = jax.tree_util.tree_map(
+        np.asarray, canonical_carry(runner.executor.carry))
+    secs = runner.failover_drill()
+    assert secs > 0
+    after = jax.tree_util.tree_map(
+        np.asarray, canonical_carry(runner.executor.carry))
+    for a, b in zip(jax.tree_util.tree_leaves(before),
+                    jax.tree_util.tree_leaves(after)):
+        np.testing.assert_array_equal(a, b)
+    # The job keeps running and can recover a REAL failure afterwards.
+    runner.inject_failure([3])
+    report = runner.recover()
+    assert report.records_replayed >= 0
+
+
+def test_failover_drill_refuses_unrecoverable_set_without_damage():
+    """A drill whose failure set leaves some log with no surviving
+    replica holder must refuse BEFORE zeroing any device state (review
+    finding: the rehearsal must never corrupt a healthy job)."""
+    import jax
+    import numpy as np
+    import pytest
+    from clonos_tpu.api.environment import StreamEnvironment
+    from clonos_tpu.causal.recovery import RecoveryError
+    from clonos_tpu.runtime.cluster import ClusterRunner
+
+    env = StreamEnvironment(name="drill-bad", num_key_groups=4,
+                            default_edge_capacity=16)
+    (env.synthetic_source(vocab=7, batch_size=2, parallelism=1)
+        .key_by().window_count(num_keys=7, window_size=1 << 30,
+                               parallelism=1).sink(parallelism=1))
+    runner = ClusterRunner(env.build(), steps_per_epoch=4, log_capacity=128,
+                           max_epochs=8, inflight_ring_steps=16, seed=3)
+    runner.run_epoch(complete_checkpoint=True)
+    runner.run_epoch(complete_checkpoint=False)
+    before = jax.tree_util.tree_map(np.asarray, runner.executor.carry)
+    with pytest.raises(RecoveryError, match="no surviving determinant"):
+        runner.failover_drill()        # default set = every vertex class
+    after = jax.tree_util.tree_map(np.asarray, runner.executor.carry)
+    for a, b in zip(jax.tree_util.tree_leaves(before),
+                    jax.tree_util.tree_leaves(after)):
+        np.testing.assert_array_equal(a, b)      # raw bytes untouched
+    assert not runner.failed
+    assert runner.reports == []                  # drills never ledger

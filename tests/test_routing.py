@@ -118,15 +118,18 @@ def _rand_block(rng, K, P, B, vocab=37, fill=0.7):
 
 @pytest.mark.parametrize("cap,K,P,B", [
     (4, 7, 3, 16), (16, 7, 3, 16), (64, 7, 3, 16),
-    # P*B >= _FLAT_SORT_MIN_N exercises the flat-sort branch.
     (32, 5, 8, 600),
 ])
-def test_block_routes_bit_identical_to_per_step(cap, K, P, B):
-    """The block exchange (both the flat-sort branch and the small-n vmap
-    branch) must equal vmapping the per-step exchange, including
+@pytest.mark.parametrize("force_sort", [False, True])
+def test_block_routes_bit_identical_to_per_step(cap, K, P, B, force_sort,
+                                                monkeypatch):
+    """The block exchange (both the counting branch and the flat-sort
+    fallback) must equal vmapping the per-step exchange, including
     overflow-drop accounting (the executor switched to the block form for
     speed; semantics are pinned here)."""
     import jax
+    if force_sort:   # shrink the scratch budget so the sort path runs
+        monkeypatch.setattr(routing, "_COUNT_ROUTE_MAX_BYTES", 0)
     rng = np.random.RandomState(3)
     batch = _rand_block(rng, K, P, B)
     for T, G in [(4, 8), (1, 4), (5, 20)]:

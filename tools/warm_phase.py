@@ -1,0 +1,57 @@
+#!/usr/bin/env python
+"""Cold vs warm recovery phase breakdown at bench shapes — what still
+compiles or stalls inside the first post-prewarm recover()."""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+    os.environ.setdefault("BENCH_STEPS_PER_EPOCH", "4096")
+    import bench
+    from clonos_tpu.runtime.cluster import ClusterRunner
+    from clonos_tpu.runtime.executor import DETS_PER_STEP
+    from clonos_tpu.utils.devsync import device_sync
+
+    SPE = int(os.environ["BENCH_STEPS_PER_EPOCH"])
+    job = bench.build_job()
+    need = bench.FILL_EPOCHS * SPE * DETS_PER_STEP
+    cap = 1 << need.bit_length()
+    runner = ClusterRunner(job, steps_per_epoch=SPE, log_capacity=cap,
+                           max_epochs=16,
+                           inflight_ring_steps=1 << max(
+                               bench.FILL_EPOCHS * SPE, 2).bit_length(),
+                           recovery_block_steps=2048, seed=7)
+    t0 = time.monotonic()
+    runner.run_epoch(complete_checkpoint=True)
+    device_sync(runner.executor.carry)
+    print("epoch0:", round(time.monotonic() - t0, 1), "s", flush=True)
+    t0 = time.monotonic()
+    pw = runner.prewarm_recovery()
+    print("prewarm:", round(pw, 1), "s", flush=True)
+    for _ in range(bench.FILL_EPOCHS):
+        runner.run_epoch(complete_checkpoint=False)
+    device_sync(runner.executor.carry)
+    for label in ("cold", "warm1", "warm2"):
+        runner.inject_failure([9])
+        t0 = time.monotonic()
+        report = runner.recover()
+        device_sync(runner.executor.carry)
+        total = time.monotonic() - t0
+        print(label, round(total * 1e3, 1), "ms phases:",
+              json.dumps({k: round(v, 1)
+                          for k, v in report.phase_ms.items()}),
+              flush=True)
+        print("   replay phases:", json.dumps(
+            {k: round(v, 1) for k, v in
+             report.managers[0].result.phase_ms.items()}), flush=True)
+
+
+if __name__ == "__main__":
+    main()
