@@ -134,10 +134,18 @@ class LogReplayer:
     >=10x replay-rate target lands, BASELINE.md)."""
 
     def __init__(self, operator: Operator, parallelism: int,
-                 block_steps: int = 512, in_slot_keys=None):
+                 block_steps: int = 512, in_slot_keys=None,
+                 pad_steps: Optional[int] = None):
         self.operator = operator
         self.parallelism = parallelism
         self.block_steps = block_steps
+        #: fixed upper bound to pad the uploaded time/rng streams to (the
+        #: recoverable window, e.g. the in-flight ring depth): keeps the
+        #: tslice program's input shape INDEPENDENT of n_steps, so the
+        #: prewarmed executable serves every failure instead of
+        #: recompiling on the failure path when n differs from the drill.
+        self.pad_steps = (-(-pad_steps // block_steps) * block_steps
+                          if pad_steps else None)
         #: static [1, cap] input-slot keys when the failed subtask's input
         #: edge is statically routed (routing.StaticRoutePlan) — replay
         #: then uses the same fast static-gather aggregation as the live
@@ -274,6 +282,8 @@ class LogReplayer:
         # views are prewarmed dynamic slices — each h2d costs a full
         # tunnel round-trip, so per-chunk uploads dominate warm replay.
         npad = -(-max(n, 1) // ch) * ch
+        if self.pad_steps is not None and npad <= self.pad_steps:
+            npad = self.pad_steps
         t_all = np.full((npad,), times_np[n - 1] if n else 0, np.int32)
         r_all = np.full((npad,), rngs_np[n - 1] if n else 0, np.int32)
         t_all[:n] = times_np[:n]

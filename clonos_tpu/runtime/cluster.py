@@ -140,14 +140,26 @@ class ClusterRunner:
     def __init__(self, job: JobGraph, steps_per_epoch: int = 8,
                  num_standby: int = 1, heartbeat_timeout_s: float = 5.0,
                  checkpoint_dir: Optional[str] = None,
+                 incremental_checkpoints: bool = False,
+                 incremental_base_every: int = 8,
                  prewarm: bool = False,
                  recovery_block_steps: Optional[int] = None,
                  **executor_kw):
         self.job = job
         self.executor = LocalExecutor(job, steps_per_epoch=steps_per_epoch,
                                       **executor_kw)
-        storage = (cp.FileCheckpointStorage(checkpoint_dir)
-                   if checkpoint_dir else cp.InMemoryCheckpointStorage())
+        if incremental_checkpoints:
+            if checkpoint_dir is None:
+                raise ValueError(
+                    "incremental_checkpoints requires checkpoint_dir")
+            from clonos_tpu.runtime.incremental import (
+                IncrementalCheckpointStorage)
+            storage: cp.CheckpointStorage = IncrementalCheckpointStorage(
+                checkpoint_dir, base_every=incremental_base_every)
+        elif checkpoint_dir:
+            storage = cp.FileCheckpointStorage(checkpoint_dir)
+        else:
+            storage = cp.InMemoryCheckpointStorage()
         self.coordinator = cp.CheckpointCoordinator(
             storage, num_subtasks=job.total_subtasks(),
             base_interval_steps=steps_per_epoch)
@@ -923,7 +935,10 @@ class ClusterRunner:
                 rp = self._make_replayer(vid, sub)
                 rp._jit_block(state0, chunk0, zero((ch,)), zero((ch,)),
                               jnp.asarray(sub, jnp.int32))
-                rp._jit_tslice(zero((ch,)), jnp.asarray(0, jnp.int32))
+                # tslice serves the pad-fixed stream length (the shape
+                # every failure uses; see LogReplayer.pad_steps).
+                rp._jit_tslice(zero((rp.pad_steps or ch,)),
+                               jnp.asarray(0, jnp.int32))
             # Graft + kill + ring write (donated arg 0: disposable
             # dummies, never the live carry).
             dummy = jax.tree_util.tree_map(lambda x: jnp.zeros_like(x),
@@ -998,6 +1013,17 @@ class ClusterRunner:
                         f"surviving determinant replica under drill set "
                         f"{sorted(fset)} — drill fewer subtasks at once "
                         f"or deepen sharing/replication")
+            # Input reconstruction needs the whole replay window in the
+            # upstream rings (or spill): check BEFORE zeroing state too.
+            n_steps = self.global_step - fence
+            if (n_steps > self.executor.compiled.inflight_ring_steps
+                    and self.executor.spill_logs is None):
+                raise rec.RecoveryError(
+                    f"failover_drill: {n_steps} steps since the last "
+                    f"completed checkpoint exceed the in-flight ring "
+                    f"({self.executor.compiled.inflight_ring_steps} "
+                    f"steps) and spill is disabled — drill earlier or "
+                    f"enable spill")
         self.inject_failure(flats)
         self.recover(drill=True)
         return _time.monotonic() - t0
@@ -1248,7 +1274,8 @@ class ClusterRunner:
             v.operator, v.parallelism,
             block_steps=self._recovery_ch,
             in_slot_keys=(slot_keys[sub:sub + 1]
-                          if slot_keys is not None else None))
+                          if slot_keys is not None else None),
+            pad_steps=self.executor.compiled.inflight_ring_steps)
 
     def _log_restore_fn(self):
         cap = self.executor.compiled.log_capacity
