@@ -792,21 +792,26 @@ class IntervalJoinOperator(TwoInputOperator):
         k, w, cap = self.num_keys, self.window, self.capacity
 
         def one(lv, lt, lm, cursor, l: RecordBatch, r: RecordBatch):
-            # Insert left records into their key rings sequentially (a
-            # fori-style scan over the batch keeps per-key ring order).
-            def ins(carry, x):
-                lv, lt, lm, cursor = carry
-                key, val, ts, ok = x
-                slot = cursor[key] % w
-                lv = jnp.where(ok, lv.at[key, slot].set(val), lv)
-                lt = jnp.where(ok, lt.at[key, slot].set(ts), lt)
-                lm = jnp.where(ok, lm.at[key, slot].set(True), lm)
-                cursor = jnp.where(ok, cursor.at[key].add(1), cursor)
-                return (lv, lt, lm, cursor), 0
-
-            (lv, lt, lm, cursor), _ = jax.lax.scan(
-                ins, (lv, lt, lm, cursor),
-                (jnp.clip(l.keys, 0, k - 1), l.values, l.timestamps, l.valid))
+            # Insert the whole left batch at once. A record's ring slot is
+            # cursor[key] + its per-key arrival rank (a running bucket
+            # count — same counting trick as the routing exchange, no
+            # per-record scan); only the last ``w`` records of a key
+            # survive a single batch (earlier ones would be overwritten
+            # by the sequential semantics anyway), which also makes every
+            # scatter destination unique.
+            lk = jnp.clip(l.keys, 0, k - 1)
+            onehot = (l.valid[:, None]
+                      & (lk[:, None] == jnp.arange(k, dtype=jnp.int32)))
+            cum = jnp.cumsum(onehot.astype(jnp.int32), axis=0)  # [B, K]
+            rank = jnp.take_along_axis(cum, lk[:, None], 1)[:, 0] - 1
+            total = cum[-1]                                     # [K]
+            keep = l.valid & (total[lk] - 1 - rank < w)
+            slot = (cursor[lk] + rank) % w
+            row = jnp.where(keep, lk, k)          # k = drop row
+            lv = lv.at[row, slot].set(l.values, mode="drop")
+            lt = lt.at[row, slot].set(l.timestamps, mode="drop")
+            lm = lm.at[row, slot].set(True, mode="drop")
+            cursor = cursor + total
 
             # Join each right record against its key's ring: [B_r, W] pairs.
             rk = jnp.clip(r.keys, 0, k - 1)
@@ -823,11 +828,16 @@ class IntervalJoinOperator(TwoInputOperator):
             fv = out_vals.reshape(flat_n)
             ft = out_ts.reshape(flat_n)
             fm = match.reshape(flat_n)
-            order = jnp.argsort(~fm, stable=True)
-            take = order[:cap]
-            live = fm[take]
+            # Compact matches to the front by arrival rank (cumsum, not
+            # argsort); first ``cap`` survive, deterministically.
+            pos = jnp.cumsum(fm.astype(jnp.int32)) - 1
+            keep2 = fm & (pos < cap)
+            dst = jnp.where(keep2, pos, cap)
+            g = lambda src, z: jnp.zeros((cap + 1,), z).at[dst].set(
+                src, mode="drop")[:cap]
             return lv, lt, lm, cursor, zero_invalid(RecordBatch(
-                fk[take], fv[take], ft[take], live))
+                g(fk, jnp.int32), g(fv, jnp.int32), g(ft, jnp.int32),
+                g(fm, jnp.bool_)))
 
         lv, lt, lm, cursor, out = jax.vmap(one)(
             state["lv"], state["lt"], state["lm"], state["cursor"],

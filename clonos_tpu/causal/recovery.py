@@ -168,10 +168,14 @@ class LogReplayer:
                 v, (lo,), (block_steps,)))
         self._jit_tslice = cache[skey]
 
-    def _replay_block(self, op_state, batches, times, rngs, subtask):
+    def _replay_block(self, op_state, batches, times, rngs, subtask,
+                      consumed_in):
         """One block of replay: state has leading dim 1 (the failed subtask
         alone); operators are written over an arbitrary leading P dim, so
-        the same block code replays one subtask that ran as one lane of P."""
+        the same block code replays one subtask that ran as one lane of P.
+        ``consumed_in`` is the running consumed-record total — accumulated
+        INSIDE the program so the loop's end needs no extra eager
+        stack/sum dispatches (each costs a ~9ms tunnel round-trip)."""
         from clonos_tpu.api.operators import BlockContext
         lift = lambda b: jax.tree_util.tree_map(lambda x: x[:, None], b)
         bctx = BlockContext(
@@ -191,11 +195,10 @@ class LogReplayer:
             new_state, out = self.operator.process_block(
                 op_state, lift(batches), bctx)
             consumed = batches.count().sum()
-        # Drop the singleton P dim: out [k, 1, cap] -> [k, cap]. Emit
-        # counts and the consumed-record total ride the same program (an
-        # eager op after the call costs a ~9ms tunnel dispatch each).
+        # Drop the singleton P dim: out [k, 1, cap] -> [k, cap].
         out = jax.tree_util.tree_map(lambda x: x[:, 0], out)
-        return new_state, out, out.count(), consumed
+        return (new_state, out, out.count(),
+                consumed_in + consumed.astype(jnp.int32))
 
     #: per-step sync row layout (must match executor.DETS_PER_STEP appends)
     LAYOUT = (det.TIMESTAMP, det.RNG, det.ORDER, det.BUFFER_BUILT)
@@ -276,7 +279,7 @@ class LogReplayer:
         subtask = jnp.asarray(plan.subtask, jnp.int32)
         out_chunks: List[Any] = []
         emit_chunks: List[jnp.ndarray] = []
-        consumed_parts: List[jnp.ndarray] = []
+        consumed_acc = jnp.zeros((), jnp.int32)
         ch = self.block_steps
         # One h2d of the whole (pad-extended) time/rng streams; per-chunk
         # views are prewarmed dynamic slices — each h2d costs a full
@@ -318,21 +321,21 @@ class LogReplayer:
             else:
                 t_in = jnp.asarray(times_np[lo:hi])
                 r_in = jnp.asarray(rngs_np[lo:hi])
-            state, out, counts, consumed = self._jit_block(
-                state, chunk, t_in, r_in, subtask)
-            if plan.input_steps is not None:
-                consumed_parts.append(consumed)
+            state, out, counts, consumed_acc = self._jit_block(
+                state, chunk, t_in, r_in, subtask, consumed_acc)
             out_chunks.append(out)
             emit_chunks.append(counts)
             lo = hi
             ci += 1
-        if emit_chunks:
-            emit_counts = jnp.concatenate(emit_chunks, axis=0)
-        else:
-            emit_counts = jnp.zeros((0,), jnp.int32)
         final_state = state
-        # Pad steps emit nothing by contract; slice host-side to n.
-        emit_np = np.asarray(emit_counts)[:n]      # d2h sync point
+        # ONE concat dispatch + ONE d2h for the emit counts AND the
+        # in-program consumed total (separate eager stack/sum/transfer
+        # calls each cost a tunnel round-trip).
+        packed = jnp.concatenate(
+            emit_chunks + [consumed_acc.reshape(1)], axis=0)
+        packed_np = np.asarray(packed)             # d2h sync point
+        emit_np = packed_np[:-1][:n]
+        consumed_total = int(packed_np[-1])
         _clock("device_replay")
 
         # Regenerate the determinant rows the replayed run would log — the
@@ -361,9 +364,7 @@ class LogReplayer:
             rebuilt[sync_pos.ravel()] = blocks.reshape(
                 n * k, det.NUM_LANES)
 
-        consumed = (int(np.asarray(jnp.stack(consumed_parts)).sum())
-                    if plan.input_steps is not None and consumed_parts
-                    else 0 if plan.input_steps is not None
+        consumed = (consumed_total if plan.input_steps is not None
                     else int(emit_np.sum()))
         _clock("rebuild_rows")
         return ReplayResult(

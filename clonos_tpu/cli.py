@@ -41,10 +41,20 @@ def cmd_run(args) -> int:
     job = _load_job(args.job)
     runner = ClusterRunner(job, steps_per_epoch=args.steps_per_epoch,
                            checkpoint_dir=args.checkpoint_dir)
+    endpoint = None
+    if args.metrics_port is not None:
+        from clonos_tpu.utils.metrics import MetricsEndpoint
+        endpoint = MetricsEndpoint(runner.metrics, port=args.metrics_port)
+        print(f"# metrics: http://{endpoint.address[0]}:"
+              f"{endpoint.address[1]}/metrics", file=sys.stderr)
     t0 = time.monotonic()
-    for _ in range(args.epochs):
-        runner.run_epoch()
-        runner.watchdog.check()
+    try:
+        for _ in range(args.epochs):
+            runner.run_epoch()
+            runner.watchdog.check()
+    finally:
+        if endpoint is not None:
+            endpoint.close()
     dt = time.monotonic() - t0
     snap = runner.metrics.snapshot()
     print(json.dumps({"job": job.name, "epochs": args.epochs,
@@ -98,6 +108,9 @@ def main(argv=None) -> int:
     pr.add_argument("--checkpoint-dir", default=None)
     pr.set_defaults(fn=cmd_run)
 
+    pr.add_argument("--metrics-port", type=int, default=None,
+                    help="serve /metrics (Prometheus) + /metrics.json "
+                         "on this port while running (0 = ephemeral)")
     pi = sub.add_parser("info", help="describe a job graph")
     pi.add_argument("job")
     pi.set_defaults(fn=cmd_info)

@@ -934,7 +934,8 @@ class ClusterRunner:
             for sub in subs:
                 rp = self._make_replayer(vid, sub)
                 rp._jit_block(state0, chunk0, zero((ch,)), zero((ch,)),
-                              jnp.asarray(sub, jnp.int32))
+                              jnp.asarray(sub, jnp.int32),
+                              jnp.zeros((), jnp.int32))
                 # tslice serves the pad-fixed stream length (the shape
                 # every failure uses; see LogReplayer.pad_steps).
                 rp._jit_tslice(zero((rp.pad_steps or ch,)),

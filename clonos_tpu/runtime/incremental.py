@@ -170,19 +170,23 @@ class IncrementalCheckpointStorage(CheckpointStorage):
 
     def _recover_index(self) -> None:
         """Rebuild the chain index from disk (process restart over the
-        same directory — FileCheckpointStorage scans the same way).
-        Files whose chain is broken (their base was removed) are
-        unreadable and deleted so the directory can't grow unboundedly
-        across runs."""
+        same directory — FileCheckpointStorage scans the same way). Only
+        each file's small meta header is read (the payload is a second
+        pickle object, skipped), so startup I/O scales with the index,
+        not total checkpoint bytes. Persisted tombstones re-mark logical
+        deletions; files whose chain is broken (their base was removed)
+        are unreadable and deleted so the directory can't grow
+        unboundedly across runs."""
         found: Dict[int, Tuple[str, Optional[int]]] = {}
         for fn in os.listdir(self.root):
             if not (fn.startswith("inc_") and fn.endswith(".pkl")):
                 continue
             try:
-                meta = self._load(int(fn[4:-4]))
+                meta = self._load_meta(int(fn[4:-4]))
                 found[meta["checkpoint_id"]] = (meta["kind"], meta["base"])
             except Exception:
                 continue
+
         def chain_ok(cid: int) -> bool:
             seen = set()
             while found[cid][0] == "delta":
@@ -201,14 +205,38 @@ class IncrementalCheckpointStorage(CheckpointStorage):
                     os.remove(self._path(cid))
                 except OSError:
                     pass
+        try:
+            with open(self._tomb_path()) as f:
+                import json
+                self._zombie = {c for c in json.load(f)
+                                if c in self._index}
+        except (OSError, ValueError):
+            self._zombie = set()
+        self._gc()
 
     def _path(self, cid: int) -> str:
         return os.path.join(self.root, f"inc_{cid}.pkl")
+
+    def _tomb_path(self) -> str:
+        return os.path.join(self.root, "tombstones.json")
+
+    def _write_tombstones(self) -> None:
+        import json
+        tmp = self._tomb_path() + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(sorted(self._zombie), f)
+        os.replace(tmp, self._tomb_path())
 
     def write(self, ckpt: CompletedCheckpoint) -> None:
         # A full snapshot every base_every-th write (deltas in between).
         force_full = (self._since_base + 1 >= self.base_every
                       or not self._order)
+        # The diff shadow must only advance when the write is durable: a
+        # failed write would otherwise leave the next delta diffed
+        # against a checkpoint that was never persisted — silently
+        # missing chunks from its chain.
+        prev_shadow = self._snap._shadow
+        prev_td = self._snap._treedef
         if force_full:
             # Don't pay the diff programs + budgeted d2h only to discard
             # them — advance the shadow and materialize once.
@@ -217,21 +245,34 @@ class IncrementalCheckpointStorage(CheckpointStorage):
         else:
             kind, payload = self._snap.snapshot(ckpt.carry)
         base = self._order[-1] if kind == "delta" else None
-        rec = {"checkpoint_id": ckpt.checkpoint_id, "kind": kind,
-               "base": base, "payload": payload,
-               "wall_time": ckpt.wall_time,
-               "chunk_elems": self.chunk_elems}
-        tmp = self._path(ckpt.checkpoint_id) + ".tmp"
-        with open(tmp, "wb") as f:
-            pickle.dump(rec, f, protocol=pickle.HIGHEST_PROTOCOL)
-        os.replace(tmp, self._path(ckpt.checkpoint_id))
+        meta = {"checkpoint_id": ckpt.checkpoint_id, "kind": kind,
+                "base": base, "wall_time": ckpt.wall_time,
+                "chunk_elems": self.chunk_elems}
+        try:
+            tmp = self._path(ckpt.checkpoint_id) + ".tmp"
+            with open(tmp, "wb") as f:
+                # Object 1: small meta header (index recovery reads only
+                # this). Object 2: the payload.
+                pickle.dump(meta, f, protocol=pickle.HIGHEST_PROTOCOL)
+                pickle.dump(payload, f, protocol=pickle.HIGHEST_PROTOCOL)
+            os.replace(tmp, self._path(ckpt.checkpoint_id))
+        except BaseException:
+            self._snap._shadow = prev_shadow
+            self._snap._treedef = prev_td
+            raise
         self._index[ckpt.checkpoint_id] = (kind, base)
         self._order.append(ckpt.checkpoint_id)
         self._since_base = 0 if kind == "full" else self._since_base + 1
 
-    def _load(self, cid: int) -> dict:
+    def _load_meta(self, cid: int) -> dict:
         with open(self._path(cid), "rb") as f:
             return pickle.load(f)
+
+    def _load(self, cid: int) -> dict:
+        with open(self._path(cid), "rb") as f:
+            meta = pickle.load(f)
+            meta["payload"] = pickle.load(f)
+            return meta
 
     def _chain(self, cid: int) -> List[int]:
         """cids from the anchoring full snapshot to ``cid`` inclusive."""
@@ -264,6 +305,9 @@ class IncrementalCheckpointStorage(CheckpointStorage):
         if checkpoint_id not in self._index:
             return
         self._zombie.add(checkpoint_id)
+        # Tombstones persist so a restart can't resurrect a logically
+        # deleted checkpoint (and its file eventually GCs).
+        self._write_tombstones()
         self._gc()
 
     def _gc(self) -> None:
@@ -273,6 +317,7 @@ class IncrementalCheckpointStorage(CheckpointStorage):
         for cid in self._index:
             if cid not in self._zombie:
                 needed.update(self._chain(cid))
+        removed = False
         for cid in [z for z in self._zombie if z not in needed]:
             try:
                 os.remove(self._path(cid))
@@ -282,6 +327,9 @@ class IncrementalCheckpointStorage(CheckpointStorage):
             self._index.pop(cid, None)
             if cid in self._order:
                 self._order.remove(cid)
+            removed = True
+        if removed:
+            self._write_tombstones()
 
     def list_ids(self) -> List[int]:
         return sorted(c for c in self._index if c not in self._zombie)

@@ -257,3 +257,50 @@ class LogOccupancyWatchdog:
                 f" — checkpoint soon or determinants will be overwritten")
             return True
         return False
+
+
+class MetricsEndpoint:
+    """Serves the registry over HTTP (reference WebMonitorEndpoint /
+    rest handlers, WebMonitorEndpoint.java:148 — scoped to the two
+    surfaces a headless job needs): ``/metrics`` in Prometheus
+    exposition format, ``/metrics.json`` as a JSON snapshot. Runs on a
+    daemon thread; scrape-only (no job control), so it touches no
+    device state."""
+
+    def __init__(self, registry: MetricRegistry, host: str = "127.0.0.1",
+                 port: int = 0):
+        import http.server
+        import json as _json
+        import threading
+
+        reg = registry
+
+        class H(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                if self.path.rstrip("/") == "/metrics":
+                    body = reg.prometheus_text().encode()
+                    ctype = "text/plain; version=0.0.4"
+                elif self.path.rstrip("/") == "/metrics.json":
+                    body = _json.dumps(reg.snapshot()).encode()
+                    ctype = "application/json"
+                else:
+                    self.send_error(404)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):        # quiet server
+                pass
+
+        self._srv = http.server.ThreadingHTTPServer((host, port), H)
+        self.address = self._srv.server_address[:2]
+        self._thread = threading.Thread(target=self._srv.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def close(self) -> None:
+        self._srv.shutdown()
+        self._srv.server_close()

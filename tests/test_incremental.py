@@ -153,3 +153,34 @@ def test_index_survives_restart_and_orphans_are_gcd(tmp_path):
     st3.write(CompletedCheckpoint(checkpoint_id=9, carry=trees[0],
                                   wall_time=0.0))
     _trees_equal(st3.read(9).carry, trees[0])
+
+
+def test_tombstones_survive_restart(tmp_path):
+    """Logically deleted checkpoints must stay deleted across a restart
+    (review finding: an in-memory-only zombie set resurrected them and
+    stranded their files forever)."""
+    rng = np.random.RandomState(5)
+    st = IncrementalCheckpointStorage(str(tmp_path), base_every=10,
+                                      chunk_elems=32)
+    trees = [_tree(rng, shapes=((128,),))]
+    for i in range(3):
+        trees.append(_mutate(trees[-1], rng))
+    for i, t in enumerate(trees):
+        st.write(CompletedCheckpoint(checkpoint_id=i, carry=t,
+                                     wall_time=0.0))
+    st.delete(0)
+    st.delete(1)
+    assert st.list_ids() == [2, 3]
+    st2 = IncrementalCheckpointStorage(str(tmp_path), base_every=10,
+                                       chunk_elems=32)
+    assert st2.list_ids() == [2, 3]          # not resurrected
+    with pytest.raises(KeyError):
+        st2.read(0)
+    _trees_equal(st2.read(3).carry, trees[3])
+    st2.delete(2)
+    st2.delete(3)
+    st3 = IncrementalCheckpointStorage(str(tmp_path), base_every=10,
+                                       chunk_elems=32)
+    assert st3.list_ids() == []
+    assert [f for f in os.listdir(tmp_path)
+            if f.startswith("inc_")] == []   # chain fully GC'd

@@ -90,3 +90,31 @@ def test_cli_info_and_run(capsys):
     out = json.loads(capsys.readouterr().out)
     assert out["epochs"] == 1
     assert out["metrics"][f"job.socket-window-wordcount.supersteps"] == 2
+
+
+def test_metrics_http_endpoint_serves_prometheus_and_json():
+    import json
+    import urllib.request
+    from clonos_tpu.utils import metrics as met
+
+    reg = met.MetricRegistry()
+    g = reg.group("job.test")
+    c = g.counter("things")
+    c.inc(5)
+    ep = met.MetricsEndpoint(reg)
+    try:
+        host, port = ep.address
+        txt = urllib.request.urlopen(
+            f"http://{host}:{port}/metrics").read().decode()
+        assert "job_test_things 5" in txt
+        js = json.loads(urllib.request.urlopen(
+            f"http://{host}:{port}/metrics.json").read())
+        assert js["job.test.things"] == 5
+        import urllib.error
+        try:
+            urllib.request.urlopen(f"http://{host}:{port}/nope")
+            assert False, "404 expected"
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+    finally:
+        ep.close()
