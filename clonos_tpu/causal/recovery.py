@@ -80,6 +80,14 @@ class ReplayPlan:
     #: sink's log; its inputs replay exactly but its own output cuts have
     #: no recorded value to check against).
     verify_outputs: bool = True
+    #: Device-resident determinant stream for the clean fast path
+    #: (consistent replica, pure sync rows): (times, rngs, expected)
+    #: int32 device arrays padded to the replayer's ``pad_steps``. When
+    #: set, ``det_rows`` stays empty — the multi-MB log body never
+    #: crosses the host link (it was parsed ON DEVICE; cluster
+    #: _device_parse_fn), which was the dominant warm-recovery cost on a
+    #: tunneled backend.
+    det_device: Optional[Any] = None
 
 
 @dataclasses.dataclass
@@ -243,6 +251,11 @@ class LogReplayer:
         return ts_idx, int(used), async_events
 
     def replay(self, plan: ReplayPlan) -> ReplayResult:
+        """Drive the replay off either determinant-stream source:
+        host rows (``plan.det_rows``, parsed/spliced here) or the
+        device-resident stream (``plan.det_device`` — clean path: no log
+        body on the host, no parse, no splice; only emit counts and
+        expected cuts, a few KB, ever transfer)."""
         import time as _time
         phases: Dict[str, float] = {}
         t_last = _time.monotonic()
@@ -255,12 +268,26 @@ class LogReplayer:
 
         n = plan.n_steps
         k = len(self.LAYOUT)
-        rows = np.asarray(plan.det_rows)
-        ts_idx, used, async_events = self._parse(rows, n)
+        dev = plan.det_device is not None
+        if dev:
+            if not plan.verify_outputs:    # pragma: no cover
+                raise RecoveryError(
+                    "device stream requires verifiable (non-synthesized) "
+                    "recovery")
+            t_dev, r_dev, expected_d = plan.det_device
+            rows = np.zeros((0, det.NUM_LANES), np.int32)
+            ts_idx = np.zeros((0,), np.int64)
+            used = 0
+            async_events: List[Tuple[int, Any]] = []
+            times_np = rngs_np = expected = None
+        else:
+            rows = np.asarray(plan.det_rows)
+            ts_idx, used, async_events = self._parse(rows, n)
         _clock("parse")
-        times_np = rows[ts_idx, det.LANE_P + 1].astype(np.int32)
-        rngs_np = rows[ts_idx + 1, det.LANE_P].astype(np.int32)
-        expected = rows[ts_idx + 3, det.LANE_P].astype(np.int32)
+        if not dev:
+            times_np = rows[ts_idx, det.LANE_P + 1].astype(np.int32)
+            rngs_np = rows[ts_idx + 1, det.LANE_P].astype(np.int32)
+            expected = rows[ts_idx + 3, det.LANE_P].astype(np.int32)
 
         # Chunked inputs arrive as a plain list (one element per replay
         # block); legacy stacked inputs are a RecordBatch or a (left,
@@ -281,18 +308,21 @@ class LogReplayer:
         emit_chunks: List[jnp.ndarray] = []
         consumed_acc = jnp.zeros((), jnp.int32)
         ch = self.block_steps
-        # One h2d of the whole (pad-extended) time/rng streams; per-chunk
-        # views are prewarmed dynamic slices — each h2d costs a full
-        # tunnel round-trip, so per-chunk uploads dominate warm replay.
-        npad = -(-max(n, 1) // ch) * ch
-        if self.pad_steps is not None and npad <= self.pad_steps:
-            npad = self.pad_steps
-        t_all = np.full((npad,), times_np[n - 1] if n else 0, np.int32)
-        r_all = np.full((npad,), rngs_np[n - 1] if n else 0, np.int32)
-        t_all[:n] = times_np[:n]
-        r_all[:n] = rngs_np[:n]
-        t_dev = jnp.asarray(t_all)
-        r_dev = jnp.asarray(r_all)
+        if not dev:
+            # One h2d of the whole (pad-extended) time/rng streams;
+            # per-chunk views are prewarmed dynamic slices — each h2d
+            # costs a full tunnel round-trip, so per-chunk uploads
+            # dominate warm replay. (The device stream arrives already
+            # padded to pad_steps.)
+            npad = -(-max(n, 1) // ch) * ch
+            if self.pad_steps is not None and npad <= self.pad_steps:
+                npad = self.pad_steps
+            t_all = np.full((npad,), times_np[n - 1] if n else 0, np.int32)
+            r_all = np.full((npad,), rngs_np[n - 1] if n else 0, np.int32)
+            t_all[:n] = times_np[:n]
+            r_all[:n] = rngs_np[:n]
+            t_dev = jnp.asarray(t_all)
+            r_dev = jnp.asarray(r_all)
         lo = 0
         ci = 0
         while lo < n:
@@ -302,9 +332,10 @@ class LogReplayer:
             # shape with repeated time/rng and (already all-invalid) pad
             # inputs, so the warm standby's prewarmed program serves every
             # n; pad-unsafe operators (pure generators) run the exact tail
-            # and pay one small compile.
-            pad = (kk < ch and self.operator.replay_pad_safe
-                   and (chunked or plan.input_steps is None))
+            # and pay one small compile. The device stream is pad-safe by
+            # construction (the clean-path guard requires it).
+            pad = dev or (kk < ch and self.operator.replay_pad_safe
+                          and (chunked or plan.input_steps is None))
             if chunked:
                 chunk = plan.input_steps[ci]
             elif plan.input_steps is None:
@@ -328,14 +359,20 @@ class LogReplayer:
             lo = hi
             ci += 1
         final_state = state
-        # ONE concat dispatch + ONE d2h for the emit counts AND the
-        # in-program consumed total (separate eager stack/sum/transfer
-        # calls each cost a tunnel round-trip).
-        packed = jnp.concatenate(
-            emit_chunks + [consumed_acc.reshape(1)], axis=0)
+        # ONE concat dispatch + ONE d2h for the emit counts, the
+        # in-program consumed total, and (device path) the expected cuts
+        # (separate eager stack/sum/transfer calls each cost a tunnel
+        # round-trip).
+        tail = [consumed_acc.reshape(1)]
+        if dev:
+            tail.append(expected_d[:max(n, 1)])
+        packed = jnp.concatenate(emit_chunks + tail, axis=0)
         packed_np = np.asarray(packed)             # d2h sync point
-        emit_np = packed_np[:-1][:n]
-        consumed_total = int(packed_np[-1])
+        n_emit = sum(int(c.shape[0]) for c in emit_chunks)
+        emit_np = packed_np[:n_emit][:n]
+        consumed_total = int(packed_np[n_emit])
+        if dev:
+            expected = packed_np[n_emit + 1:][:n]
         _clock("device_replay")
 
         # Regenerate the determinant rows the replayed run would log — the

@@ -96,9 +96,11 @@ def _scatter_to_targets(
     return zero_invalid(out), dropped
 
 
-#: cap on the counting exchange's [K, n, T+1] cumsum scratch; bigger
-#: routes fall back to the flat sort (memory, not speed, is the bound).
-_COUNT_ROUTE_MAX_BYTES = 256 << 20
+#: cap on the counting exchange's [K, n, T+1] cumsum scratch (priced at
+#: ~3 concurrent buffers); routes past it fall back to the flat sort.
+#: Sized to cover whole-recovery-window routes (m=8192 at bench shapes
+#: ~0.9GB — the sort there is ~10x slower, tools/ab_route.py).
+_COUNT_ROUTE_MAX_BYTES = 2 << 30
 
 
 def _block_to_targets(

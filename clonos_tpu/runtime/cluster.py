@@ -293,6 +293,54 @@ class ClusterRunner:
             return f
         return self._jitted(("fetch_meta", h), make)
 
+    def _pad_steps(self) -> int:
+        ch = self._recovery_ch
+        return -(-self.executor.compiled.inflight_ring_steps // ch) * ch
+
+    def _device_parse_fn(self):
+        """Parse a consistent replica's determinant stream ON DEVICE:
+        locate the per-step sync anchors, extract the time/rng/expected
+        lanes (padded to the replayer's fixed stream length), and report
+        whether the stream is 'clean' (pure sync rows, exact layout).
+        Only ~16 bytes of metadata cross the host link — the multi-MB
+        log body stays on device (it IS the replica; the restore path
+        copies it device-side too). Reference contrast: the JVM replayer
+        walks the byte log on-heap (LogReplayerImpl.java:36-157)."""
+        cap = self.executor.compiled.log_capacity
+        maxn = self._pad_steps()
+        k = DETS_PER_STEP
+
+        def make():
+            def f(replicas, r, from_epoch):
+                buf, count, start = clog.get_determinants(
+                    jax.tree_util.tree_map(lambda x: x[r], replicas),
+                    from_epoch, cap)
+                tags = buf[:, det.LANE_TAG]
+                rowmask = jnp.arange(cap) < count
+                cond = (rowmask & (tags == det.TIMESTAMP)
+                        & (buf[:, det.LANE_RC] == 0))
+                n_anchors = cond.sum().astype(jnp.int32)
+                ids = jnp.nonzero(cond, size=maxn,
+                                  fill_value=cap - k)[0].astype(jnp.int32)
+                amask = jnp.arange(maxn) < n_anchors
+                layout = jnp.all(
+                    ~amask
+                    | ((tags[ids + 1] == det.RNG)
+                       & (tags[ids + 2] == det.ORDER)
+                       & (tags[ids + 3] == det.BUFFER_BUILT)))
+                clean = layout & (count == n_anchors * k)
+                last = jnp.maximum(n_anchors - 1, 0)
+                t_raw = buf[ids, det.LANE_P + 1]
+                r_raw = buf[ids + 1, det.LANE_P]
+                times = jnp.where(amask, t_raw, t_raw[last])
+                rngs = jnp.where(amask, r_raw, r_raw[last])
+                expected = jnp.where(amask, buf[ids + 3, det.LANE_P], 0)
+                small = jnp.stack([count, start, n_anchors,
+                                   clean.astype(jnp.int32)])
+                return times, rngs, expected, small
+            return f
+        return self._jitted(("device_parse",), make)
+
     def _ring_bounds(self) -> Dict[int, Tuple[int, int]]:
         """(tail, head) of every in-flight ring in ONE device read — ring
         offsets don't move during recovery (write-backs change contents
@@ -676,6 +724,8 @@ class ClusterRunner:
                 # sinks, TwoPhaseCommitSinkFunction.)
                 synthesized = True
             r_best = None
+            det_device = None
+            clean_n = None
             if holders:
                 # One device call for every holder's (count, start); the
                 # holders are bit-identical replicas by construction, so
@@ -687,16 +737,40 @@ class ClusterRunner:
                     jnp.asarray(from_epoch, jnp.int32)))
                 consistent = (len(np.unique(meta[:, 0])) == 1
                               and len(np.unique(meta[:, 1])) == 1)
-                use = ([holders[0]] if consistent else holders)
-                mgr.expect_determinant_responses(len(use))
-                fetch = self._fetch_fn()
-                for j, (r, _h) in enumerate(use):
-                    buf, count, start = fetch(
-                        patched.replicas, jnp.asarray(r, jnp.int32),
+                # Clean fast path: parse the consistent replica ON
+                # DEVICE; if the stream is pure sync rows the multi-MB
+                # body never crosses the host link (restore copies it
+                # device-side too). Any irregularity (async rows, layout
+                # drift, step mismatch) falls back to the host path.
+                from clonos_tpu.api.operators import HostFeedSource
+                if consistent and n_steps > 0 \
+                        and v.operator.replay_pad_safe \
+                        and not isinstance(v.operator, HostFeedSource) \
+                        and n_steps <= self._pad_steps():
+                    t_d, r_d, e_d, small = self._device_parse_fn()(
+                        patched.replicas,
+                        jnp.asarray(holders[0][0], jnp.int32),
                         jnp.asarray(from_epoch, jnp.int32))
-                    mgr.notify_determinant_response(
-                        np.asarray(buf)[: int(meta[j, 0])],
-                        int(meta[j, 1]))
+                    cnt_s, start_s, nanch, cleanflag = (
+                        int(x) for x in np.asarray(small))
+                    if cleanflag and nanch == n_steps:
+                        det_device = (t_d, r_d, e_d)
+                        clean_n, clean_start = cnt_s, start_s
+                        mgr.expect_determinant_responses(1)
+                        mgr.notify_determinant_response(
+                            np.zeros((0, det.NUM_LANES), np.int32),
+                            start_s)
+                if det_device is None:
+                    use = ([holders[0]] if consistent else holders)
+                    mgr.expect_determinant_responses(len(use))
+                    fetch = self._fetch_fn()
+                    for j, (r, _h) in enumerate(use):
+                        buf, count, start = fetch(
+                            patched.replicas, jnp.asarray(r, jnp.int32),
+                            jnp.asarray(from_epoch, jnp.int32))
+                        mgr.notify_determinant_response(
+                            np.asarray(buf)[: int(meta[j, 0])],
+                            int(meta[j, 1]))
                 # A single consistent replica's device bytes can restore
                 # the log directly; disagreeing holders must go through
                 # the host merge (r_best None -> chunked upload path).
@@ -706,9 +780,12 @@ class ClusterRunner:
             if synthesized:
                 rows = self._synthesize_det_rows(fence, n_steps)
                 start = int(np.asarray(snap.log_heads[flat]))
+            elif det_device is not None:
+                rows = np.zeros((0, det.NUM_LANES), np.int32)
+                start = clean_start
             else:
                 rows, start = mgr.merged_determinants()
-            total_dets += len(rows)
+            total_dets += clean_n if clean_n is not None else len(rows)
             tp = _clock("fetch_determinants", tp)
 
             # Lost inputs: the checkpointed edge buffer (the depth-1 batch
@@ -739,7 +816,8 @@ class ClusterRunner:
                 from_epoch=from_epoch, input_steps=input_steps,
                 det_rows=rows, det_start=start,
                 checkpoint_op_state=snap.op_states[vid],
-                n_steps=n_steps, verify_outputs=not synthesized)
+                n_steps=n_steps, verify_outputs=not synthesized,
+                det_device=det_device)
             result = mgr.run_replay(plan)
             total_records += result.records_replayed
             # Re-fire recovered timer effects (rows are already spliced
@@ -776,7 +854,9 @@ class ClusterRunner:
 
             patched = self._patch(patched, snap, vid, sub, flat,
                                   result, rebuilt, from_epoch, fence,
-                                  n_steps, replica_src=r_best)
+                                  n_steps, replica_src=r_best,
+                                  det_n=clean_n,
+                                  clean_sync=det_device is not None)
             tp = _clock("patch", tp)
 
         # Replica rows held by revived subtasks: replicas are identical to
@@ -860,6 +940,9 @@ class ClusterRunner:
         if compiled.plan.num_replicas > 0:
             self._fetch_fn()(carry.replicas, jnp.asarray(0, jnp.int32),
                              jnp.asarray(0, jnp.int32))
+            self._device_parse_fn()(carry.replicas,
+                                    jnp.asarray(0, jnp.int32),
+                                    jnp.asarray(0, jnp.int32))
             holders_per_owner = {}
             for (o, _h) in compiled.plan.pairs:
                 holders_per_owner[o] = holders_per_owner.get(o, 0) + 1
@@ -1366,20 +1449,32 @@ class ClusterRunner:
     def _patch(self, carry: JobCarry, snap: LeanSnapshot, vid: int,
                sub: int, flat: int, result: rec.ReplayResult,
                det_rows: np.ndarray, from_epoch: int, fence: int,
-               n_steps: int, replica_src: Optional[int] = None
+               n_steps: int, replica_src: Optional[int] = None,
+               det_n: Optional[int] = None, clean_sync: bool = False
                ) -> JobCarry:
         """Graft the rebuilt subtask back into the live carry. Every
         device program here is fixed-shape (chunked appends/writes) so a
-        prewarmed standby pays zero XLA compile on the failure path."""
+        prewarmed standby pays zero XLA compile on the failure path.
+
+        ``clean_sync`` (device-resident determinant stream): the rows
+        never came to the host, but the stream is pure k-row sync blocks
+        so the anchors are exactly ``i * DETS_PER_STEP``; ``det_n`` is
+        its device-verified row count."""
         compiled = self.executor.compiled
         ch4 = self._chunk() * DETS_PER_STEP
         ck_head = int(np.asarray(snap.log_heads[flat]))
-        n = det_rows.shape[0]
+        n = det_rows.shape[0] if det_n is None else det_n
         # Epoch->offset index entries died with the task; rebuild them from
         # the fence-step ledger. Sync blocks anchor at TIMESTAMP rows.
-        ts_pos = (np.where((det_rows[:, det.LANE_TAG] == det.TIMESTAMP)
-                           & (det_rows[:, det.LANE_RC] == 0))[0]
-                  if n > 0 else np.zeros((0,), np.int64))
+        if clean_sync:
+            ts_pos = np.arange(n // DETS_PER_STEP,
+                               dtype=np.int64) * DETS_PER_STEP
+        elif n > 0:
+            ts_pos = np.where(
+                (det_rows[:, det.LANE_TAG] == det.TIMESTAMP)
+                & (det_rows[:, det.LANE_RC] == 0))[0]
+        else:
+            ts_pos = np.zeros((0,), np.int64)
         me = compiled.max_epochs
         epoch_offs = np.zeros((me,), np.int32)
         epoch_mask = np.zeros((me,), bool)

@@ -237,18 +237,22 @@ class IncrementalCheckpointStorage(CheckpointStorage):
         # missing chunks from its chain.
         prev_shadow = self._snap._shadow
         prev_td = self._snap._treedef
-        if force_full:
-            # Don't pay the diff programs + budgeted d2h only to discard
-            # them — advance the shadow and materialize once.
-            self._snap.advance_shadow(ckpt.carry)
-            kind, payload = "full", carry_to_host(ckpt.carry)
-        else:
-            kind, payload = self._snap.snapshot(ckpt.carry)
-        base = self._order[-1] if kind == "delta" else None
-        meta = {"checkpoint_id": ckpt.checkpoint_id, "kind": kind,
-                "base": base, "wall_time": ckpt.wall_time,
-                "chunk_elems": self.chunk_elems}
+        # Everything from shadow advance through the durable rename sits
+        # under one rollback guard: an exception ANYWHERE (diff program,
+        # d2h, disk full, interrupt) must leave the shadow at the last
+        # PERSISTED checkpoint, or the next delta silently misses chunks.
         try:
+            if force_full:
+                # Don't pay the diff programs + budgeted d2h only to
+                # discard them — advance the shadow, materialize once.
+                self._snap.advance_shadow(ckpt.carry)
+                kind, payload = "full", carry_to_host(ckpt.carry)
+            else:
+                kind, payload = self._snap.snapshot(ckpt.carry)
+            base = self._order[-1] if kind == "delta" else None
+            meta = {"checkpoint_id": ckpt.checkpoint_id, "kind": kind,
+                    "base": base, "wall_time": ckpt.wall_time,
+                    "chunk_elems": self.chunk_elems}
             tmp = self._path(ckpt.checkpoint_id) + ".tmp"
             with open(tmp, "wb") as f:
                 # Object 1: small meta header (index recovery reads only

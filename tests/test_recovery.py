@@ -340,3 +340,20 @@ def test_failover_drill_refuses_unrecoverable_set_without_damage():
         np.testing.assert_array_equal(a, b)      # raw bytes untouched
     assert not runner.failed
     assert runner.reports == []                  # drills never ledger
+
+
+def test_clean_recovery_uses_device_resident_stream():
+    """A window failure with a consistent replica and a pure-sync stream
+    must take the device-parse fast path (no log body on the host) and
+    still recover bit-identically (covered by the golden tests above —
+    this pins that the fast path is actually the one being exercised)."""
+    r = _runner(TIMES)
+    r.run_epoch()
+    r.step()
+    r.step()
+    r.inject_failure([3])
+    report = r.recover()
+    mgr = report.managers[0]
+    assert mgr.plan.det_device is not None        # device stream used
+    assert mgr.plan.det_rows.shape[0] == 0        # no host rows pulled
+    assert report.determinants_replayed > 0       # counted from device meta
