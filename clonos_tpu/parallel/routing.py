@@ -98,9 +98,27 @@ def _scatter_to_targets(
 
 #: cap on the counting exchange's [K, n, T+1] cumsum scratch (priced at
 #: ~3 concurrent buffers); routes past it fall back to the flat sort.
-#: Sized to cover whole-recovery-window routes (m=8192 at bench shapes
-#: ~0.9GB — the sort there is ~10x slower, tools/ab_route.py).
-_COUNT_ROUTE_MAX_BYTES = 2 << 30
+#: Resolved lazily from the device's memory limit (~2% of HBM — a 95GB
+#: chip affords the ~0.9GB whole-recovery-window route where the sort
+#: is ~10x slower, tools/ab_route.py; a small-memory device falls back
+#: instead of OOMing next to its GB-scale log state). None = unresolved.
+_COUNT_ROUTE_MAX_BYTES = None
+_COUNT_ROUTE_FALLBACK_BYTES = 256 << 20
+
+
+def _count_route_budget() -> int:
+    global _COUNT_ROUTE_MAX_BYTES
+    if _COUNT_ROUTE_MAX_BYTES is None:
+        budget = _COUNT_ROUTE_FALLBACK_BYTES
+        try:
+            stats = jax.devices()[0].memory_stats() or {}
+            limit = int(stats.get("bytes_limit", 0))
+            if limit > 0:
+                budget = max(budget, min(2 << 30, limit // 48))
+        except Exception:
+            pass
+        _COUNT_ROUTE_MAX_BYTES = budget
+    return _COUNT_ROUTE_MAX_BYTES
 
 
 def _block_to_targets(
@@ -132,7 +150,7 @@ def _block_to_targets(
     # Price the ~3 concurrent [K, n, T+1] buffers this branch holds (the
     # one-hot's int32 cast, the cumsum output, and one fusion temp), not
     # just one — the cap must actually bound peak scratch.
-    if K * n * (T + 1) * 4 * 3 <= _COUNT_ROUTE_MAX_BYTES:
+    if K * n * (T + 1) * 4 * 3 <= _count_route_budget():
         fl = lambda x: jnp.reshape(x, (K, n))
         keys, vals, ts, valid = map(fl, batch)
         tgt = jnp.where(valid, fl(target), T)

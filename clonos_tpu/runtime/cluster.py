@@ -201,6 +201,16 @@ class ClusterRunner:
         #: lazily and by prewarm_recovery() (warm standby: no XLA compile
         #: in the failure path).
         self._rjit: Dict[Any, Any] = {}
+        #: routed edge-window cache, scoped to one vertex's failed
+        #: subtasks within one recover() call (the exchange output is
+        #: consumer-independent; see _replay_inputs). Populated only
+        #: when the current vertex has >= 2 failed subtasks — the
+        #: all-lane blocks are P-times a lane's size, so caching buys
+        #: nothing for the common single-subtask failure.
+        self._route_cache: Dict[Any, Any] = {}
+        self._route_cache_enabled = False
+        #: observability/test hook: cache hits in the last recover()
+        self._route_cache_hits = 0
         self._last_records_total = 0
         # Host epoch control plane (reference EpochTrackerImpl): the
         # listener bus + record counting driven from the fused per-epoch
@@ -360,10 +370,14 @@ class ClusterRunner:
 
     def _route_chunk_fn(self, eidx: int, m: int):
         """Read + route one [m]-step window of edge ``eidx``'s producer
-        ring and select one destination subtask's lane — fused into one
-        program with the loop state (window start, rebalance offset,
-        remaining needed steps) carried ON DEVICE: per-chunk host scalars
-        would cost a ~8ms device_put each over the tunnel.
+        ring to ALL destination lanes — one program with the loop state
+        (window start, rebalance offset, remaining needed steps) carried
+        ON DEVICE: per-chunk host scalars would cost a ~8ms device_put
+        each over the tunnel. The routed block is subtask-INDEPENDENT,
+        so a connected multi-subtask failure routes each edge window
+        once and lane-selects per consumer (the reference re-serves the
+        in-flight log per requesting channel; here the exchange is the
+        expensive part and it is shared).
 
         ``need_left`` masks steps past the replay range to invalid: a
         fixed-size window can extend past the steps the failed subtask
@@ -372,21 +386,27 @@ class ClusterRunner:
         def make():
             body = self._route_body(eidx, m)
 
-            def f(el, start, sub, rr0, need_left):
+            def f(el, start, rr0, need_left):
                 raw, _cnt, _s0 = ifl.slice_steps(el, start, m)
-                routed_sub, cnt = body(raw, sub, rr0, need_left)
-                return (routed_sub, start + m, rr0 + cnt, need_left - m)
+                routed, cnt = body(raw, rr0, need_left)
+                return (routed, start + m, rr0 + cnt, need_left - m)
             return f
         return self._jitted(("route_chunk", eidx, m), make)
 
+    def _lane_select_fn(self, eidx: int, m: int):
+        """Select one consumer lane of a routed [m, P, cap] block."""
+        return self._jitted(("lane_select", eidx, m), lambda: (
+            lambda routed, sub: jax.tree_util.tree_map(
+                lambda x: x[:, sub], routed)))
+
     def _route_body(self, eidx: int, m: int):
         """The shared exchange-replay body: mask steps past ``need_left``
-        invalid, route, select the destination subtask's lane."""
+        invalid and route to all destination lanes."""
         e = self.job.edges[eidx]
         dst_p = self.job.vertices[e.dst].parallelism
         compiled = self.executor.compiled
 
-        def body(raw, sub, rr0, need_left):
+        def body(raw, rr0, need_left):
             need = jnp.clip(need_left, 0, m)
             live = jnp.arange(m, dtype=jnp.int32) < need
             raw = raw._replace(valid=raw.valid & live[:, None, None])
@@ -404,8 +424,7 @@ class ClusterRunner:
                     raw, dst_p, e.capacity, offs)
             else:
                 r, _ = routing.route_broadcast_block(raw, dst_p, e.capacity)
-            routed_sub = jax.tree_util.tree_map(lambda x: x[:, sub], r)
-            return routed_sub, raw.count().sum()
+            return r, raw.count().sum()
         return body
 
     def _route_raw_fn(self, eidx: int, m: int):
@@ -415,9 +434,9 @@ class ClusterRunner:
         def make():
             body = self._route_body(eidx, m)
 
-            def f(raw, start, sub, rr0, need_left):
-                routed_sub, cnt = body(raw, sub, rr0, need_left)
-                return (routed_sub, start + m, rr0 + cnt, need_left - m)
+            def f(raw, start, rr0, need_left):
+                routed, cnt = body(raw, rr0, need_left)
+                return (routed, start + m, rr0 + cnt, need_left - m)
             return f
         return self._jitted(("route_raw", eidx, m), make)
 
@@ -686,10 +705,25 @@ class ClusterRunner:
 
         patched = self.executor.carry
         self._bounds_cache = self._ring_bounds()
+        self._route_cache = {}
+        self._route_cache_hits = 0
+        vid_failed_counts: Dict[int, int] = {}
+        for flat in failed:
+            v_of = self._vertex_of(flat)[0]
+            vid_failed_counts[v_of] = vid_failed_counts.get(v_of, 0) + 1
+        prev_vid = None
         tp = _clock("restore", t0)
 
         for flat in failed:
             vid, sub = self._vertex_of(flat)
+            if vid != prev_vid:
+                # Routed windows are valid only while the upstream rings
+                # they read are final — scope the share to one vertex's
+                # consumers (upstream vertices were patched earlier in
+                # topological order).
+                self._route_cache = {}
+                self._route_cache_enabled = vid_failed_counts[vid] >= 2
+                prev_vid = vid
             v = self.job.vertices[vid]
             mgr = rec.RecoveryManager(vid, sub, flat,
                                       self._make_replayer(vid, sub))
@@ -882,6 +916,7 @@ class ClusterRunner:
 
         self.executor.carry = patched
         self._bounds_cache = None
+        self._route_cache = {}     # free the held routed device buffers
         from clonos_tpu.utils.devsync import device_sync
         device_sync(patched)
         tp = _clock("replica_rebuild", tp)
@@ -991,14 +1026,15 @@ class ClusterRunner:
                         continue
                     self._ring_chunk_fn(ri, m)(el, jnp.asarray(0, jnp.int32))
                     z = jnp.asarray(0, jnp.int32)
-                    self._route_chunk_fn(eidx, m)(el, z, z, z, z)
+                    routed, *_ = self._route_chunk_fn(eidx, m)(el, z, z, z)
+                    self._lane_select_fn(eidx, m)(routed, z)
                     if spill_paths:
                         # Spill-path twin (AVAILABILITY wrap recovery):
                         # doubles the exchange compiles, so opt-in — a
                         # ring-covered recovery (the common case) never
                         # takes this path.
                         self._route_raw_fn(eidx, m)(
-                            zero_batch((m, src_p, src_cap)), z, z, z, z)
+                            zero_batch((m, src_p, src_cap)), z, z, z)
                 self._first_chunk_fn(eidx)(
                     zero_batch((1, e.capacity)),
                     zero_batch((ch - 1, e.capacity)))
@@ -1272,21 +1308,34 @@ class ClusterRunner:
             if m == 0:
                 chunks.append(first)
                 continue
-            covered = (h_start >= ring_lo and h_start >= tail
-                       and head - h_start >= h_need)
-            if covered:
-                routed, start_d, rr_d, need_d = self._route_chunk_fn(
-                    eidx, m)(el, start_d, sub_d, rr_d, need_d)
+            # The routed block covers every destination lane, so for a
+            # connected multi-subtask failure the (expensive) exchange
+            # runs once per edge window; later consumers only pay the
+            # lane select (recover() scopes the cache per vertex).
+            key = (eidx, i)
+            cached = self._route_cache.get(key)
+            if cached is None:
+                covered = (h_start >= ring_lo and h_start >= tail
+                           and head - h_start >= h_need)
+                if covered:
+                    routed, start_d, rr_d, need_d = self._route_chunk_fn(
+                        eidx, m)(el, start_d, rr_d, need_d)
+                else:
+                    # Spill path (ring shortfall): host-assembled chunk.
+                    raw = self._ring_steps(patched, e.src, h_start, m,
+                                           need=h_need)
+                    routed, start_d, rr_d, need_d = self._route_raw_fn(
+                        eidx, m)(raw, start_d, rr_d, need_d)
+                if self._route_cache_enabled:
+                    self._route_cache[key] = routed
             else:
-                # Spill path (ring shortfall): host-assembled raw chunk.
-                raw = self._ring_steps(patched, e.src, h_start, m,
-                                       need=h_need)
-                routed, start_d, rr_d, need_d = self._route_raw_fn(
-                    eidx, m)(raw, start_d, sub_d, rr_d, need_d)
+                routed = cached
+                self._route_cache_hits += 1
+            lane = self._lane_select_fn(eidx, m)(routed, sub_d)
             if i == 0:
-                chunks.append(self._first_chunk_fn(eidx)(first, routed))
+                chunks.append(self._first_chunk_fn(eidx)(first, lane))
             else:
-                chunks.append(routed)
+                chunks.append(lane)
         return chunks
 
     def _reread_feed(self, vid: int, sub: int, snap: LeanSnapshot,

@@ -6,6 +6,12 @@ Run:
     python -m clonos_tpu run examples.nexmark_join:build_job --epochs 2
 """
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
 from clonos_tpu.api.environment import StreamEnvironment
 
 KEYS = 499

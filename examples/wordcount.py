@@ -11,6 +11,12 @@ Run:
                                             # mid-run failure + recovery
 """
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
 from clonos_tpu.api.environment import StreamEnvironment
 
 VOCAB = 1000
@@ -44,9 +50,11 @@ def main():
     from clonos_tpu.runtime.cluster import ClusterRunner
 
     runner = ClusterRunner(build_job(), steps_per_epoch=8)
-    print("running 2 epochs...")
+    print("running 2 epochs + a few mid-epoch steps...")
     runner.run_epoch()
     runner.run_epoch()
+    for _ in range(5):                   # mid-epoch: the failure loses
+        runner.step()                    # un-checkpointed work to replay
     print(f"records so far: "
           f"{int(np.sum(np.asarray(runner.executor.carry.record_counts)))}")
 

@@ -357,3 +357,28 @@ def test_clean_recovery_uses_device_resident_stream():
     assert mgr.plan.det_device is not None        # device stream used
     assert mgr.plan.det_rows.shape[0] == 0        # no host rows pulled
     assert report.determinants_replayed > 0       # counted from device meta
+
+
+def test_same_vertex_pair_failure_shares_routed_windows():
+    """Two subtasks of the SAME vertex fail together: the second consumer
+    reuses the first's routed edge windows (cache-hit path) and recovery
+    stays bit-identical vs a never-failed run."""
+    golden = _runner(TIMES, parallelism=2)
+    golden.run_epoch()
+    golden.step()
+    golden.step()
+
+    r = _runner(TIMES, parallelism=2)
+    r.run_epoch()
+    r.step()
+    r.step()
+    r.inject_failure([2, 3])          # BOTH window subtasks
+    report = r.recover()
+    assert report.failed_subtasks == (2, 3)
+    # The second consumer must have HIT the shared routed windows (pins
+    # the cache keying; bit-identity alone would pass a broken cache).
+    assert r._route_cache_hits > 0
+    _carries_equal(r.executor.carry, golden.executor.carry)
+    golden.step()
+    r.step()
+    _carries_equal(r.executor.carry, golden.executor.carry)
