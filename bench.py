@@ -87,12 +87,14 @@ def bench_config4():
     prewarm_s = runner.prewarm_recovery()
     runner.run_epoch(complete_checkpoint=False)
     device_sync(runner.executor.carry)
-    runner.failover_drill([2, job.subtask_base(1) + 3])
+    wbase = job.subtask_base(1)
+    rbase = job.subtask_base(2)
+    # One subtask of EVERY class the measured cascading failure hits —
+    # the recovery number must measure the protocol, not warmup.
+    runner.failover_drill([1, wbase + 2, rbase + 6])
     device_sync(runner.executor.carry)
     # Cascading connected failures: feed source + window + reduce subtasks
     # on one path (3 vertex classes at once).
-    wbase = job.subtask_base(1)
-    rbase = job.subtask_base(2)
     runner.inject_failure([2, wbase + 3, rbase + 7])
     t0 = time.monotonic()
     report = runner.recover()

@@ -111,10 +111,18 @@ def _count_route_budget() -> int:
     if _COUNT_ROUTE_MAX_BYTES is None:
         budget = _COUNT_ROUTE_FALLBACK_BYTES
         try:
-            stats = jax.devices()[0].memory_stats() or {}
+            dev = jax.devices()[0]
+            stats = dev.memory_stats() or {}
             limit = int(stats.get("bytes_limit", 0))
             if limit > 0:
                 budget = max(budget, min(2 << 30, limit // 48))
+            elif dev.platform == "tpu":
+                # Stats unavailable (e.g. tunneled backends report
+                # None): every TPU generation has >= 16GB HBM — the
+                # 2GB cap is safe, and the conservative fallback would
+                # silently push whole-recovery-window routes onto the
+                # ~10x slower sort.
+                budget = 2 << 30
         except Exception:
             pass
         _COUNT_ROUTE_MAX_BYTES = budget

@@ -368,16 +368,22 @@ class ClusterRunner:
         return self._jitted(("ring_chunk", ri, m), lambda: (
             lambda el, start: ifl.slice_steps(el, start, m)))
 
-    def _route_chunk_fn(self, eidx: int, m: int):
+    def _route_chunk_fn(self, eidx: int, m: int, all_lanes: bool = False):
         """Read + route one [m]-step window of edge ``eidx``'s producer
-        ring to ALL destination lanes — one program with the loop state
-        (window start, rebalance offset, remaining needed steps) carried
-        ON DEVICE: per-chunk host scalars would cost a ~8ms device_put
-        each over the tunnel. The routed block is subtask-INDEPENDENT,
-        so a connected multi-subtask failure routes each edge window
-        once and lane-selects per consumer (the reference re-serves the
-        in-flight log per requesting channel; here the exchange is the
-        expensive part and it is shared).
+        ring — one program with the loop state (window start, rebalance
+        offset, remaining needed steps) carried ON DEVICE: per-chunk
+        host scalars would cost a ~8ms device_put each over the tunnel.
+
+        Two variants, both prewarmed:
+        - fused (default): the consumer's lane is selected INSIDE the
+          program. Crucial for the single-failure case: XLA then scatters
+          only that lane's rows (a general scatter runs ~row-at-a-time
+          on TPU, so materializing all P lanes costs ~P times more).
+        - ``all_lanes``: the full [m, P, cap] routed block — the routing
+          is consumer-independent, so a connected multi-subtask failure
+          routes each window ONCE and lane-selects per consumer (the
+          reference re-serves the in-flight log per requesting channel;
+          here the exchange is the expensive part and it is shared).
 
         ``need_left`` masks steps past the replay range to invalid: a
         fixed-size window can extend past the steps the failed subtask
@@ -386,12 +392,20 @@ class ClusterRunner:
         def make():
             body = self._route_body(eidx, m)
 
-            def f(el, start, rr0, need_left):
-                raw, _cnt, _s0 = ifl.slice_steps(el, start, m)
-                routed, cnt = body(raw, rr0, need_left)
-                return (routed, start + m, rr0 + cnt, need_left - m)
+            if all_lanes:
+                def f(el, start, rr0, need_left):
+                    raw, _cnt, _s0 = ifl.slice_steps(el, start, m)
+                    routed, cnt = body(raw, rr0, need_left)
+                    return (routed, start + m, rr0 + cnt, need_left - m)
+            else:
+                def f(el, start, sub, rr0, need_left):
+                    raw, _cnt, _s0 = ifl.slice_steps(el, start, m)
+                    routed, cnt = body(raw, rr0, need_left)
+                    lane = jax.tree_util.tree_map(
+                        lambda x: x[:, sub], routed)
+                    return (lane, start + m, rr0 + cnt, need_left - m)
             return f
-        return self._jitted(("route_chunk", eidx, m), make)
+        return self._jitted(("route_chunk", eidx, m, all_lanes), make)
 
     def _lane_select_fn(self, eidx: int, m: int):
         """Select one consumer lane of a routed [m, P, cap] block."""
@@ -427,18 +441,25 @@ class ClusterRunner:
             return r, raw.count().sum()
         return body
 
-    def _route_raw_fn(self, eidx: int, m: int):
+    def _route_raw_fn(self, eidx: int, m: int, all_lanes: bool = False):
         """Spill-path twin of :meth:`_route_chunk_fn`: routes a
         host-assembled raw chunk instead of reading the device ring,
         advancing the same device-carried loop state."""
         def make():
             body = self._route_body(eidx, m)
 
-            def f(raw, start, rr0, need_left):
-                routed, cnt = body(raw, rr0, need_left)
-                return (routed, start + m, rr0 + cnt, need_left - m)
+            if all_lanes:
+                def f(raw, start, rr0, need_left):
+                    routed, cnt = body(raw, rr0, need_left)
+                    return (routed, start + m, rr0 + cnt, need_left - m)
+            else:
+                def f(raw, start, sub, rr0, need_left):
+                    routed, cnt = body(raw, rr0, need_left)
+                    lane = jax.tree_util.tree_map(
+                        lambda x: x[:, sub], routed)
+                    return (lane, start + m, rr0 + cnt, need_left - m)
             return f
-        return self._jitted(("route_raw", eidx, m), make)
+        return self._jitted(("route_raw", eidx, m, all_lanes), make)
 
     def _replica_copy_fn(self):
         return self._jitted(("replica_copy",), lambda: (
@@ -720,9 +741,23 @@ class ClusterRunner:
                 # Routed windows are valid only while the upstream rings
                 # they read are final — scope the share to one vertex's
                 # consumers (upstream vertices were patched earlier in
-                # topological order).
+                # topological order). The cache holds full [m, P, cap]
+                # blocks, so bound its bytes: past the budget every
+                # consumer takes the fused per-lane path instead of an
+                # OOM mid-recovery.
                 self._route_cache = {}
-                self._route_cache_enabled = vid_failed_counts[vid] >= 2
+                share = vid_failed_counts[vid] >= 2
+                if share and n_steps > 0:
+                    ch_ = self._chunk()
+                    nblocks_ = -(-n_steps // ch_)
+                    est = sum(
+                        nblocks_ * ch_
+                        * self.job.vertices[self.job.edges[e2].dst
+                                            ].parallelism
+                        * self.job.edges[e2].capacity * 4 * 4
+                        for e2 in self.job.in_edges(vid))
+                    share = est <= (1 << 30)
+                self._route_cache_enabled = share
                 prev_vid = vid
             v = self.job.vertices[vid]
             mgr = rec.RecoveryManager(vid, sub, flat,
@@ -1026,14 +1061,21 @@ class ClusterRunner:
                         continue
                     self._ring_chunk_fn(ri, m)(el, jnp.asarray(0, jnp.int32))
                     z = jnp.asarray(0, jnp.int32)
-                    routed, *_ = self._route_chunk_fn(eidx, m)(el, z, z, z)
+                    # Both variants: fused lane (single failure) and
+                    # all-lane + select (connected-failure sharing).
+                    self._route_chunk_fn(eidx, m)(el, z, z, z, z)
+                    routed, *_ = self._route_chunk_fn(
+                        eidx, m, all_lanes=True)(el, z, z, z)
                     self._lane_select_fn(eidx, m)(routed, z)
                     if spill_paths:
                         # Spill-path twin (AVAILABILITY wrap recovery):
                         # doubles the exchange compiles, so opt-in — a
                         # ring-covered recovery (the common case) never
-                        # takes this path.
+                        # takes this path. Both variants, like the ring
+                        # route above.
                         self._route_raw_fn(eidx, m)(
+                            zero_batch((m, src_p, src_cap)), z, z, z, z)
+                        self._route_raw_fn(eidx, m, all_lanes=True)(
                             zero_batch((m, src_p, src_cap)), z, z, z)
                 self._first_chunk_fn(eidx)(
                     zero_batch((1, e.capacity)),
@@ -1308,30 +1350,43 @@ class ClusterRunner:
             if m == 0:
                 chunks.append(first)
                 continue
-            # The routed block covers every destination lane, so for a
-            # connected multi-subtask failure the (expensive) exchange
-            # runs once per edge window; later consumers only pay the
-            # lane select (recover() scopes the cache per vertex).
-            key = (eidx, i)
-            cached = self._route_cache.get(key)
-            if cached is None:
-                covered = (h_start >= ring_lo and h_start >= tail
-                           and head - h_start >= h_need)
+            covered = (h_start >= ring_lo and h_start >= tail
+                       and head - h_start >= h_need)
+            share = self._route_cache_enabled
+            if not share:
+                # Single failed consumer: the fused variant scatters only
+                # this lane's rows (~P times cheaper than materializing
+                # the whole routed block).
                 if covered:
-                    routed, start_d, rr_d, need_d = self._route_chunk_fn(
-                        eidx, m)(el, start_d, rr_d, need_d)
+                    lane, start_d, rr_d, need_d = self._route_chunk_fn(
+                        eidx, m)(el, start_d, sub_d, rr_d, need_d)
                 else:
-                    # Spill path (ring shortfall): host-assembled chunk.
                     raw = self._ring_steps(patched, e.src, h_start, m,
                                            need=h_need)
-                    routed, start_d, rr_d, need_d = self._route_raw_fn(
-                        eidx, m)(raw, start_d, rr_d, need_d)
-                if self._route_cache_enabled:
-                    self._route_cache[key] = routed
+                    lane, start_d, rr_d, need_d = self._route_raw_fn(
+                        eidx, m)(raw, start_d, sub_d, rr_d, need_d)
             else:
-                routed = cached
-                self._route_cache_hits += 1
-            lane = self._lane_select_fn(eidx, m)(routed, sub_d)
+                # Multiple failed consumers: route the window once to all
+                # lanes, cache it, and lane-select per consumer
+                # (recover() scopes the cache to one vertex's group).
+                key = (eidx, i)
+                cached = self._route_cache.get(key)
+                if cached is None:
+                    if covered:
+                        routed, start_d, rr_d, need_d = \
+                            self._route_chunk_fn(eidx, m, all_lanes=True)(
+                                el, start_d, rr_d, need_d)
+                    else:
+                        raw = self._ring_steps(patched, e.src, h_start, m,
+                                               need=h_need)
+                        routed, start_d, rr_d, need_d = \
+                            self._route_raw_fn(eidx, m, all_lanes=True)(
+                                raw, start_d, rr_d, need_d)
+                    self._route_cache[key] = routed
+                else:
+                    routed = cached
+                    self._route_cache_hits += 1
+                lane = self._lane_select_fn(eidx, m)(routed, sub_d)
             if i == 0:
                 chunks.append(self._first_chunk_fn(eidx)(first, lane))
             else:

@@ -28,14 +28,20 @@ def test_keyed_hist_kernel_matches_xla(b):
     np.testing.assert_array_equal(np.asarray(c1), np.asarray(c2))
 
 
-def test_bulk_append_full_matches_masked_append():
-    """The block executor's bulk path (append_full, unique-index scatter)
-    must agree with the general masked append for full batches."""
+@pytest.mark.parametrize("cap,sizes", [
+    (64, (4, 16, 28)),       # dense pad/roll branch (n * 64 >= cap)
+    (512, (4, 6, 3)),        # small-append scatter branch (n * 64 < cap)
+    (64, (1, 40, 2)),        # mixed: both branches across rounds
+])
+def test_bulk_append_full_matches_masked_append(cap, sizes):
+    """The block executor's bulk path (append_full — dense pad/roll for
+    large appends, unique-index scatter for small ones) must agree with
+    the general masked append, including ring wraps."""
     rng = np.random.RandomState(3)
-    L, cap = 4, 64
+    L = 4
     a = jax.vmap(lambda _: clog.create(cap, 8))(jnp.arange(L))
     b = jax.vmap(lambda _: clog.create(cap, 8))(jnp.arange(L))
-    for n in (4, 16, 28):   # wraps the ring across rounds
+    for n in sizes:
         rows = jnp.asarray(rng.randint(-9, 9, (L, n, 8)), jnp.int32)
         a = clog.v_append_full(a, rows)
         b = clog.v_append(b, rows, jnp.full((L,), n, jnp.int32))
