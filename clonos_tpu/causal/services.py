@@ -102,6 +102,35 @@ class CausalTimeService(AbstractCausalService):
         return d.timestamp
 
 
+class PeriodicCausalTimeService(CausalTimeService):
+    """Amortized time: the wall clock is sampled at most once per
+    ``period_ms`` and reads in between return the cached value
+    (reference PeriodicCausalTimeService.java — there a periodic task
+    refreshes the field; here the refresh rides the read path, which is
+    deterministic given the same record/replay stream). Every read
+    still logs its TimestampDeterminant, so replay is exact even though
+    the underlying clock was sampled sparsely."""
+
+    def __init__(self, append, replay_feed=None, clock=None,
+                 period_ms: int = 10):
+        super().__init__(append, replay_feed, clock)
+        self._period = period_ms
+        self._raw_clock = self._clock
+        self._cached = None
+        self._next_refresh = float("-inf")
+
+        def amortized() -> int:
+            # Gate the (possibly expensive) time source behind the cheap
+            # monotonic clock: it is sampled at most once per period_ms,
+            # the actual amortization the periodic variant exists for.
+            now = _time.monotonic() * 1000.0
+            if self._cached is None or now >= self._next_refresh:
+                self._cached = self._raw_clock()
+                self._next_refresh = now + self._period
+            return self._cached
+        self._clock = amortized
+
+
 class CausalRandomService(AbstractCausalService):
     """Host random draws with record/replay
     (DeterministicCausalRandomService equivalent)."""
@@ -164,6 +193,11 @@ class CausalServiceFactory:
 
     def time_service(self) -> CausalTimeService:
         return CausalTimeService(self._append, self._feed, self._clock)
+
+    def periodic_time_service(self, period_ms: int = 10
+                              ) -> "PeriodicCausalTimeService":
+        return PeriodicCausalTimeService(self._append, self._feed,
+                                         self._clock, period_ms)
 
     def random_service(self) -> CausalRandomService:
         return CausalRandomService(self._append, self._feed, self._seed)

@@ -118,11 +118,12 @@ def _count_route_budget() -> int:
                 budget = max(budget, min(2 << 30, limit // 48))
             elif dev.platform == "tpu":
                 # Stats unavailable (e.g. tunneled backends report
-                # None): every TPU generation has >= 16GB HBM — the
-                # 2GB cap is safe, and the conservative fallback would
-                # silently push whole-recovery-window routes onto the
-                # ~10x slower sort.
-                budget = 2 << 30
+                # None): every TPU generation has >= 16GB HBM, but we
+                # can't see what's free — grant 1GB (covers the
+                # whole-recovery-window route, ~0.9GB at bench shapes,
+                # where the sort fallback is ~10x slower) rather than
+                # the full 2GB the stats path would allow.
+                budget = 1 << 30
         except Exception:
             pass
         _COUNT_ROUTE_MAX_BYTES = budget

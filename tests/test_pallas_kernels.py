@@ -31,7 +31,8 @@ def test_keyed_hist_kernel_matches_xla(b):
 @pytest.mark.parametrize("cap,sizes", [
     (64, (4, 16, 28)),       # dense pad/roll branch (n * 64 >= cap)
     (512, (4, 6, 3)),        # small-append scatter branch (n * 64 < cap)
-    (64, (1, 40, 2)),        # mixed: both branches across rounds
+    (512, (4, 200, 3, 380)), # mixed: scatter resumes at a head the
+                             # dense branch advanced, and wraps
 ])
 def test_bulk_append_full_matches_masked_append(cap, sizes):
     """The block executor's bulk path (append_full — dense pad/roll for

@@ -131,3 +131,40 @@ def test_async_rows_interleave_and_recovery_stays_bit_identical():
     fb, _ = jax.tree_util.tree_flatten(b)
     for xa, xb in zip(fa, fb):
         np.testing.assert_array_equal(np.asarray(xa), np.asarray(xb))
+
+
+def test_periodic_time_service_amortizes_but_replays_exact():
+    """PeriodicCausalTimeService: the (possibly expensive) time source
+    is sampled at most once per period, every read still logs, and
+    replay reproduces the recorded values exactly (reference
+    PeriodicCausalTimeService.java)."""
+    from clonos_tpu.causal.services import PeriodicCausalTimeService
+
+    wall = iter([100, 150, 260, 300, 301, 302])
+    samples = []
+
+    def clock():
+        v = next(wall)
+        samples.append(v)
+        return v
+
+    logged = []
+    # A huge period: the expensive source is sampled exactly ONCE for
+    # any number of reads — the amortization the class exists for.
+    svc = PeriodicCausalTimeService(logged.append, clock=clock,
+                                    period_ms=1 << 30)
+    got = [svc.current_time_millis() for _ in range(4)]
+    assert got == [100, 100, 100, 100]
+    assert len(samples) == 1                # one expensive sample
+    assert len(logged) == 4                 # every read logged
+    # period 0: every read refreshes from the source.
+    svc0 = PeriodicCausalTimeService(logged.append, clock=clock,
+                                     period_ms=0)
+    assert [svc0.current_time_millis() for _ in range(2)] == [150, 260]
+    # Replay: the recorded determinants reproduce the values with NO
+    # clock access at all.
+    from clonos_tpu.causal.services import ReplayFeed
+    feed = ReplayFeed(list(logged[:4]))
+    svc2 = PeriodicCausalTimeService(lambda d: None, replay_feed=feed,
+                                     clock=lambda: 1 / 0)
+    assert [svc2.current_time_millis() for _ in range(4)] == got
