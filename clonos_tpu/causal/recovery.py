@@ -118,6 +118,16 @@ class ReplayResult:
     #: clean fast path, where verify() already establishes equality) —
     #: callers must not "re-verify" it against the same buffer.
     rebuilt_is_view: bool = False
+    #: Deferred-sync replay (``replay(plan, defer_sync=True)``): nothing
+    #: crossed the host link — ``emit_counts``/``expected_emits`` are
+    #: device arrays, ``records_replayed`` is -1 until the cluster's
+    #: final packed read resolves it, and verification is the device
+    #: flag ``verify_ok_d`` (folded into that same read). On a tunneled
+    #: backend every host sync costs a ~100ms round-trip, so the warm
+    #: failure path defers them all into one.
+    deferred: bool = False
+    verify_ok_d: Optional[Any] = None
+    consumed_d: Optional[Any] = None
 
     def verify(self) -> None:
         """Post-replay equality asserts (reference LogReplayerImpl:127,
@@ -250,12 +260,19 @@ class LogReplayer:
                         for j in range(len(async_pos))]
         return ts_idx, int(used), async_events
 
-    def replay(self, plan: ReplayPlan) -> ReplayResult:
+    def replay(self, plan: ReplayPlan,
+               defer_sync: bool = False) -> ReplayResult:
         """Drive the replay off either determinant-stream source:
         host rows (``plan.det_rows``, parsed/spliced here) or the
         device-resident stream (``plan.det_device`` — clean path: no log
         body on the host, no parse, no splice; only emit counts and
-        expected cuts, a few KB, ever transfer)."""
+        expected cuts, a few KB, ever transfer).
+
+        ``defer_sync`` (device stream only): dispatch everything and
+        transfer NOTHING — the output-cut verification becomes a device
+        flag and the consumed total stays a device scalar, both folded
+        into the cluster's single end-of-recovery read (ReplayResult
+        fields ``verify_ok_d`` / ``consumed_d``)."""
         import time as _time
         phases: Dict[str, float] = {}
         t_last = _time.monotonic()
@@ -359,6 +376,23 @@ class LogReplayer:
             lo = hi
             ci += 1
         final_state = state
+        if defer_sync:
+            if not dev:    # pragma: no cover - cluster guards eligibility
+                raise RecoveryError(
+                    "defer_sync requires the device-resident determinant "
+                    "stream (host-row plans must parse on the host)")
+            emit_d = jnp.concatenate(emit_chunks, axis=0)[:n]
+            exp_d = expected_d[:n]
+            ok_d = jnp.all(emit_d == exp_d)
+            _clock("device_replay")
+            return ReplayResult(
+                op_state=final_state,
+                rebuilt_log_rows=rows[:0], emit_counts=emit_d,
+                expected_emits=exp_d,
+                out_chunks=out_chunks if out_chunks else None,
+                records_replayed=-1, async_events=[],
+                phase_ms=phases, rebuilt_is_view=True,
+                deferred=True, verify_ok_d=ok_d, consumed_d=consumed_acc)
         # ONE concat dispatch + ONE d2h for the emit counts, the
         # in-program consumed total, and (device path) the expected cuts
         # (separate eager stack/sum/transfer calls each cost a tunnel
@@ -502,12 +536,13 @@ class RecoveryManager:
         from clonos_tpu.causal.replication import merge_determinant_responses
         return merge_determinant_responses(self._responses)
 
-    def run_replay(self, plan: ReplayPlan) -> ReplayResult:
+    def run_replay(self, plan: ReplayPlan,
+                   defer_sync: bool = False) -> ReplayResult:
         if self.state != RecoveryState.REPLAYING:
             raise RecoveryError(f"replay in state {self.state}")
         self.plan = plan
-        self.result = self.replayer.replay(plan)
-        if plan.verify_outputs:
+        self.result = self.replayer.replay(plan, defer_sync=defer_sync)
+        if plan.verify_outputs and not self.result.deferred:
             self.result.verify()
         self._goto(RecoveryState.RUNNING)
         return self.result

@@ -24,7 +24,8 @@ replica logs with the same offset-dedup rule as on-chip
 The CRC and the bulk row memcpy are the per-frame hot path; a C++
 implementation (native/delta_codec.cpp, loaded via ctypes) handles them
 when built, with a bit-identical pure-Python fallback
-(tests/test_serde.py pins parity).
+(tests/test_remote.py::test_native_codec_matches_python_fallback pins
+parity).
 """
 
 from __future__ import annotations

@@ -141,6 +141,26 @@ def truncate(state: EdgeLogState, completed_epoch) -> EdgeLogState:
                           epoch_base=jnp.maximum(e + 1, state.epoch_base))
 
 
+def slice_steps_at(state: EdgeLogState, abs_step, max_out: int
+                   ) -> RecordBatch:
+    """Gather ``max_out`` steps from exactly ``abs_step`` with NO tail
+    clamp: slots before the ring tail come back as whatever the ring
+    holds there (stale or clobbered) — the caller must mask them. Used
+    by recovery's uniform replay windows, whose first window starts one
+    slot before the fence (that dead slot is replaced by the
+    checkpointed edge buffer; see cluster._replay_inputs)."""
+    start = jnp.asarray(abs_step, jnp.int32)
+    count = jnp.clip(state.head - start, 0, max_out)
+    idx = jnp.arange(max_out, dtype=jnp.int32)
+    pos = (start + idx) & (state.ring_steps - 1)
+    live = (idx < count)[:, None, None]
+    return RecordBatch(
+        keys=jnp.where(live, state.keys[pos], 0),
+        values=jnp.where(live, state.values[pos], 0),
+        timestamps=jnp.where(live, state.timestamps[pos], 0),
+        valid=jnp.where(live, state.valid[pos], False))
+
+
 def slice_steps(state: EdgeLogState, abs_step, max_out: int
                 ) -> Tuple[RecordBatch, jnp.ndarray, jnp.ndarray]:
     """Gather up to ``max_out`` retained steps from ``abs_step``. Returns

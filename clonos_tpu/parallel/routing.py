@@ -171,6 +171,24 @@ def _block_to_targets(
         counts = pos_all[:, -1, :T]
         keep = (tgt < T) & (pos < out_capacity)
         dropped = jnp.maximum(counts - out_capacity, 0).astype(jnp.int32)
+        # Placement: (target, rank) pairs are UNIQUE per step, so a keyed
+        # histogram over the flattened slot id IS the routed batch (sum
+        # of one contribution = select) — the Pallas VPU kernel streams
+        # it where an XLA element scatter ran ~50ms/field at bench
+        # shapes (see _block_to_target_lane).
+        from clonos_tpu.ops.histogram import keyed_hist
+        nk = T * out_capacity
+        # The kernel's per-chunk compare tile is [8, 128, nk-padded] i32;
+        # keep it comfortably inside VMEM, else fall back to the scatter.
+        if nk <= (1 << 14):
+            slot = jnp.where(keep, tgt * out_capacity + pos, -1)
+            out_k, cnt = keyed_hist(slot, keys, keep, nk)
+            out_v, _ = keyed_hist(slot, vals, keep, nk, want_counts=False)
+            out_t, _ = keyed_hist(slot, ts, keep, nk, want_counts=False)
+            sh = (K, T, out_capacity)
+            out = RecordBatch(out_k.reshape(sh), out_v.reshape(sh),
+                              out_t.reshape(sh), cnt.reshape(sh) > 0)
+            return zero_invalid(out), dropped
         row = jnp.where(keep, tgt, T)
         col = jnp.where(keep, pos, 0)
         kidx = jnp.arange(K, dtype=jnp.int32)[:, None]
@@ -208,6 +226,78 @@ def _block_to_targets(
     pick = order[jnp.clip(src, 0, K * n - 1)]                # [K, T, cap]
     out = RecordBatch(keys[pick], vals[pick], ts[pick], ok)
     return zero_invalid(out), dropped
+
+
+def _block_to_target_lane(batch: RecordBatch, target: jnp.ndarray,
+                          lane, out_capacity: int) -> RecordBatch:
+    """ONE consumer lane of :func:`_block_to_targets` — bit-identical to
+    ``_block_to_targets(...)[0][:, lane]``.
+
+    A record's slot within its target is its arrival rank; for a single
+    lane that is a running count over a ``[K, n]`` membership mask — no
+    ``[K, n, T+1]`` one-hot — so scratch and compute shrink by (T+1)x
+    and the single-failure replay exchange stays on the counting path
+    at whole-recovery-window K, where the full route falls back to the
+    flat 67M-record sort (~400ms at bench shapes; this is ~10x less)."""
+    from clonos_tpu.ops.histogram import keyed_hist
+    K, P, B = batch.keys.shape
+    n = P * B
+    fl = lambda x: jnp.reshape(x, (K, n))
+    keys, vals, ts, valid = map(fl, batch)
+    tgt = jnp.where(valid, fl(target), -1)
+    hit = tgt == lane
+    pos = jnp.cumsum(hit.astype(jnp.int32), axis=1) - 1
+    keep = hit & (pos < out_capacity)
+    # Placement is "field value at the record whose rank == c" — ranks
+    # are UNIQUE per step, so a keyed histogram over them IS the routed
+    # batch (sum of one contribution = select). The Pallas VPU kernel
+    # streams it in compare-accumulate chunks; an XLA element scatter
+    # here ran ~50ms/field at bench shapes, the kernel ~5ms.
+    slot = jnp.where(keep, pos, -1)
+    out_k, cnt = keyed_hist(slot, keys, keep, out_capacity)
+    out_v, _ = keyed_hist(slot, vals, keep, out_capacity,
+                          want_counts=False)
+    out_t, _ = keyed_hist(slot, ts, keep, out_capacity,
+                          want_counts=False)
+    return zero_invalid(RecordBatch(out_k, out_v, out_t, cnt > 0))
+
+
+def route_hash_block_lane(batch: RecordBatch, lane, parallelism: int,
+                          num_key_groups: int, out_capacity: int
+                          ) -> RecordBatch:
+    """One consumer lane of :func:`route_hash_block` (single-failure
+    replay: only the failed subtask's inputs are reconstructed)."""
+    kg = key_group(batch.keys, num_key_groups)
+    return _block_to_target_lane(
+        batch, subtask_for_key_group(kg, parallelism, num_key_groups),
+        lane, out_capacity)
+
+
+def route_rebalance_block_lane(batch: RecordBatch, lane, parallelism: int,
+                               out_capacity: int, offsets: jnp.ndarray
+                               ) -> RecordBatch:
+    """One consumer lane of :func:`route_rebalance_block`."""
+    K, P, B = batch.keys.shape
+    idx = jnp.arange(P * B, dtype=jnp.int32)[None, :] + offsets[:, None]
+    return _block_to_target_lane(
+        batch, (idx % parallelism).reshape(K, P, B), lane, out_capacity)
+
+
+def route_broadcast_block_lane(batch: RecordBatch, lane,
+                               out_capacity: int) -> RecordBatch:
+    """One consumer lane of :func:`route_broadcast_block` (every lane
+    receives the same packed records; ``lane`` is ignored)."""
+    del lane
+    return _block_to_target_lane(
+        batch, jnp.zeros(batch.keys.shape, jnp.int32), 0, out_capacity)
+
+
+def route_forward_block_lane(batch: RecordBatch, lane,
+                             out_capacity: int) -> RecordBatch:
+    """One consumer lane of :func:`route_forward_block`."""
+    one = jax.tree_util.tree_map(lambda x: x[:, lane][:, None], batch)
+    routed, _ = route_forward_block(one, out_capacity)
+    return jax.tree_util.tree_map(lambda x: x[:, 0], routed)
 
 
 def route_hash(batch: RecordBatch, parallelism: int, num_key_groups: int,

@@ -212,6 +212,19 @@ class ClusterRunner:
         #: observability/test hook: cache hits in the last recover()
         self._route_cache_hits = 0
         self._last_records_total = 0
+        #: checkpoint id -> np [L] log heads at that fence, harvested from
+        #: the per-epoch health read (recovery's patch phase reads them
+        #: here instead of round-tripping the device on the failure path).
+        self._ck_log_heads: Dict[int, np.ndarray] = {}
+        #: host mirror of the in-flight ring offsets: heads advance one
+        #: per superstep (== global_step), tails move only at checkpoint
+        #: completion (ifl.truncate to the completed epoch's end fence).
+        #: Lets recover() make its routing coverage decisions without a
+        #: device read; the device bounds are still compared against the
+        #: mirror in recovery's final packed read (fail-loud, not trust).
+        self._ring_tail_mirror = 0
+        self._ring_mirror_valid = True
+        self.coordinator.subscribe_completion(self._update_ring_mirror)
         # Host epoch control plane (reference EpochTrackerImpl): the
         # listener bus + record counting driven from the fused per-epoch
         # health read; checkpoint completions fan out through it.
@@ -351,18 +364,42 @@ class ClusterRunner:
             return f
         return self._jitted(("device_parse",), make)
 
+    def _ring_bounds_dev(self):
+        """Device [R, 2] (tail, head) of every in-flight ring — dispatch
+        only; recover() folds the transfer into its packed reads."""
+        if not self.executor.carry.out_rings:
+            return None
+        fn = self._jitted(("ring_bounds",), lambda: (
+            lambda rings: jnp.stack(
+                [jnp.stack([el.tail, el.head]) for el in rings])))
+        return fn(self.executor.carry.out_rings)
+
     def _ring_bounds(self) -> Dict[int, Tuple[int, int]]:
         """(tail, head) of every in-flight ring in ONE device read — ring
         offsets don't move during recovery (write-backs change contents
         only), so recover() reads them once instead of twice per chunk."""
-        if not self.executor.carry.out_rings:
+        dev = self._ring_bounds_dev()
+        if dev is None:
             return {}
-        fn = self._jitted(("ring_bounds",), lambda: (
-            lambda rings: jnp.stack(
-                [jnp.stack([el.tail, el.head]) for el in rings])))
-        arr = np.asarray(fn(self.executor.carry.out_rings))
+        arr = np.asarray(dev)
         return {ri: (int(arr[ri, 0]), int(arr[ri, 1]))
                 for ri in range(arr.shape[0])}
+
+    def _update_ring_mirror(self, completed_epoch: int) -> None:
+        """Checkpoint-completion hook: advance the host ring-tail mirror
+        to the completed epoch's end fence (matches ifl.truncate). A
+        completion whose fence the runner never saw (executor driven
+        directly, e.g. by a test) invalidates the mirror — recover()
+        then reads the device bounds instead of trusting stale ones."""
+        f = self._fence_step.get(completed_epoch + 1)
+        if f is None:
+            self._ring_mirror_valid = False
+        else:
+            self._ring_tail_mirror = max(self._ring_tail_mirror, f)
+        # Recovery only ever restores from the latest completed
+        # checkpoint — drop older fence-head entries (bounded ledger).
+        self._ck_log_heads = {k: v for k, v in self._ck_log_heads.items()
+                              if k >= completed_epoch}
 
     def _ring_chunk_fn(self, ri: int, m: int):
         return self._jitted(("ring_chunk", ri, m), lambda: (
@@ -370,9 +407,10 @@ class ClusterRunner:
 
     def _route_chunk_fn(self, eidx: int, m: int, all_lanes: bool = False):
         """Read + route one [m]-step window of edge ``eidx``'s producer
-        ring — one program with the loop state (window start, rebalance
-        offset, remaining needed steps) carried ON DEVICE: per-chunk
-        host scalars would cost a ~8ms device_put each over the tunnel.
+        ring — one program with the loop state (window start, leading
+        skip, rebalance offset, remaining needed steps) carried ON
+        DEVICE: per-chunk host scalars would cost a ~8ms device_put each
+        over the tunnel.
 
         Two variants, both prewarmed:
         - fused (default): the consumer's lane is selected INSIDE the
@@ -385,25 +423,30 @@ class ClusterRunner:
           reference re-serves the in-flight log per requesting channel;
           here the exchange is the expensive part and it is shared).
 
-        ``need_left`` masks steps past the replay range to invalid: a
-        fixed-size window can extend past the steps the failed subtask
-        ever consumed — those must replay as empty inputs (the
-        replay-padding contract), not as the next epoch's records."""
+        Replay windows are UNIFORM: every window is m steps, the first
+        starting one slot before the fence (that dead slot is masked by
+        ``lead`` and later replaced by the checkpointed edge buffer) —
+        one compiled program serves every chunk instead of a first-chunk
+        (m-1) shape variant doubling the prewarm. ``need_left`` masks
+        steps past the replay range invalid (the replay-padding
+        contract); ``lead`` masks the leading dead slot of window 0."""
         def make():
-            body = self._route_body(eidx, m)
-
             if all_lanes:
-                def f(el, start, rr0, need_left):
-                    raw, _cnt, _s0 = ifl.slice_steps(el, start, m)
-                    routed, cnt = body(raw, rr0, need_left)
-                    return (routed, start + m, rr0 + cnt, need_left - m)
+                body = self._route_body(eidx, m)
+
+                def f(el, start, rr0, need_left, lead):
+                    raw = ifl.slice_steps_at(el, start, m)
+                    routed, cnt = body(raw, rr0, need_left, lead)
+                    return (routed, start + m, rr0 + cnt, need_left - m,
+                            jnp.zeros_like(lead))
             else:
-                def f(el, start, sub, rr0, need_left):
-                    raw, _cnt, _s0 = ifl.slice_steps(el, start, m)
-                    routed, cnt = body(raw, rr0, need_left)
-                    lane = jax.tree_util.tree_map(
-                        lambda x: x[:, sub], routed)
-                    return (lane, start + m, rr0 + cnt, need_left - m)
+                body = self._route_body_lane(eidx, m)
+
+                def f(el, start, sub, rr0, need_left, lead):
+                    raw = ifl.slice_steps_at(el, start, m)
+                    lane, cnt = body(raw, sub, rr0, need_left, lead)
+                    return (lane, start + m, rr0 + cnt, need_left - m,
+                            jnp.zeros_like(lead))
             return f
         return self._jitted(("route_chunk", eidx, m, all_lanes), make)
 
@@ -414,15 +457,17 @@ class ClusterRunner:
                 lambda x: x[:, sub], routed)))
 
     def _route_body(self, eidx: int, m: int):
-        """The shared exchange-replay body: mask steps past ``need_left``
-        invalid and route to all destination lanes."""
+        """The shared exchange-replay body: mask the ``lead`` leading
+        slots and steps past ``need_left`` invalid, then route to all
+        destination lanes."""
         e = self.job.edges[eidx]
         dst_p = self.job.vertices[e.dst].parallelism
         compiled = self.executor.compiled
 
-        def body(raw, rr0, need_left):
+        def body(raw, rr0, need_left, lead):
             need = jnp.clip(need_left, 0, m)
-            live = jnp.arange(m, dtype=jnp.int32) < need
+            idx = jnp.arange(m, dtype=jnp.int32)
+            live = (idx >= lead) & (idx < need)
             raw = raw._replace(valid=raw.valid & live[:, None, None])
             if eidx in compiled.static_route:
                 r, _ = compiled.static_route[eidx].apply(raw)
@@ -441,23 +486,61 @@ class ClusterRunner:
             return r, raw.count().sum()
         return body
 
+    def _route_body_lane(self, eidx: int, m: int):
+        """Single-consumer-lane exchange replay: compute the routed lane
+        ``sub`` DIRECTLY (routing._block_to_target_lane — a [m, n]
+        running count instead of the [m, n, T+1] one-hot), bit-identical
+        to the full route's lane. Keeps the single-failure replay on the
+        counting path at whole-window m where the full exchange falls
+        back to the flat sort."""
+        e = self.job.edges[eidx]
+        dst_p = self.job.vertices[e.dst].parallelism
+        compiled = self.executor.compiled
+
+        def body(raw, sub, rr0, need_left, lead):
+            need = jnp.clip(need_left, 0, m)
+            idx = jnp.arange(m, dtype=jnp.int32)
+            live = (idx >= lead) & (idx < need)
+            raw = raw._replace(valid=raw.valid & live[:, None, None])
+            if eidx in compiled.static_route:
+                r, _ = compiled.static_route[eidx].apply(raw)
+                lane = jax.tree_util.tree_map(lambda x: x[:, sub], r)
+            elif e.partition == PartitionType.HASH:
+                lane = routing.route_hash_block_lane(
+                    raw, sub, dst_p, self.job.num_key_groups, e.capacity)
+            elif e.partition == PartitionType.FORWARD:
+                lane = routing.route_forward_block_lane(
+                    raw, sub, e.capacity)
+            elif e.partition == PartitionType.REBALANCE:
+                counts = raw.count().sum(axis=1)
+                offs = rr0 + jnp.cumsum(counts) - counts
+                lane = routing.route_rebalance_block_lane(
+                    raw, sub, dst_p, e.capacity, offs)
+            else:
+                lane = routing.route_broadcast_block_lane(
+                    raw, sub, e.capacity)
+            return lane, raw.count().sum()
+        return body
+
     def _route_raw_fn(self, eidx: int, m: int, all_lanes: bool = False):
         """Spill-path twin of :meth:`_route_chunk_fn`: routes a
         host-assembled raw chunk instead of reading the device ring,
         advancing the same device-carried loop state."""
         def make():
-            body = self._route_body(eidx, m)
-
             if all_lanes:
-                def f(raw, start, rr0, need_left):
-                    routed, cnt = body(raw, rr0, need_left)
-                    return (routed, start + m, rr0 + cnt, need_left - m)
+                body = self._route_body(eidx, m)
+
+                def f(raw, start, rr0, need_left, lead):
+                    routed, cnt = body(raw, rr0, need_left, lead)
+                    return (routed, start + m, rr0 + cnt, need_left - m,
+                            jnp.zeros_like(lead))
             else:
-                def f(raw, start, sub, rr0, need_left):
-                    routed, cnt = body(raw, rr0, need_left)
-                    lane = jax.tree_util.tree_map(
-                        lambda x: x[:, sub], routed)
-                    return (lane, start + m, rr0 + cnt, need_left - m)
+                body = self._route_body_lane(eidx, m)
+
+                def f(raw, start, sub, rr0, need_left, lead):
+                    lane, cnt = body(raw, sub, rr0, need_left, lead)
+                    return (lane, start + m, rr0 + cnt, need_left - m,
+                            jnp.zeros_like(lead))
             return f
         return self._jitted(("route_raw", eidx, m, all_lanes), make)
 
@@ -468,12 +551,11 @@ class ClusterRunner:
                 replicas, logs)), donate=(0,))
 
     def _first_chunk_fn(self, eidx: int):
-        """Prepend the checkpointed depth-1 edge buffer to the first
-        routed chunk (replay step 0 consumes it)."""
+        """Replace the first window's dead leading slot with the
+        checkpointed depth-1 edge buffer (replay step 0 consumes it)."""
         return self._jitted(("first_chunk", eidx), lambda: (
             lambda buf_sub, routed: jax.tree_util.tree_map(
-                lambda a, b: jnp.concatenate([a, b], axis=0),
-                buf_sub, routed)))
+                lambda a, b: b.at[0].set(a[0]), buf_sub, routed)))
 
     # --- timers / epoch services ---------------------------------------------
 
@@ -548,10 +630,17 @@ class ClusterRunner:
         self.heartbeats.beat_all_except(self.failed)
         self._m_steps.inc(n)
         self._m_epochs.inc()
-        # One fused device read per epoch: overflow flags + record total
-        # (the tunnel round-trip is the cost unit here, not device work).
+        # One fused device read per epoch: overflow flags + record total +
+        # fence log heads (the tunnel round-trip is the cost unit here,
+        # not device work).
         vec = self.executor.health_vector()
-        total_records = int(vec[-1])
+        nf = 4 + len(self.executor.carry.out_rings)
+        total_records = int(vec[nf])
+        # The heads at this fence ARE checkpoint ``closed``'s log heads
+        # (the SOURCE_CHECKPOINT appends below come after and belong to
+        # the new epoch) — recovery's patch phase reads them from here
+        # instead of paying a device round-trip on the failure path.
+        self._ck_log_heads[closed] = vec[nf + 1:].astype(np.int64)
         delta_records = total_records - self._last_records_total
         self._m_records.mark(delta_records)
         self._last_records_total = total_records
@@ -725,7 +814,25 @@ class ClusterRunner:
             return now
 
         patched = self.executor.carry
-        self._bounds_cache = self._ring_bounds()
+        # Ring bounds for routing coverage decisions: the host mirror
+        # (tails move only at checkpoint completion, heads advance one
+        # per superstep == global_step) when valid, else one device read.
+        # The device values recovery actually used are re-checked in the
+        # final packed read either way (fail-loud, not trust).
+        bounds_dev = self._ring_bounds_dev()
+        nrings = len(patched.out_rings)
+        if self._ring_mirror_valid:
+            # Heads advance once per superstep wherever the executor is
+            # driven from; its own step counter is the authoritative one.
+            head_m = self.executor._steps_executed
+            self._bounds_cache = {
+                ri: (self._ring_tail_mirror, head_m)
+                for ri in range(nrings)}
+        else:
+            barr = (np.asarray(bounds_dev) if nrings
+                    else np.zeros((0, 2), np.int32))
+            self._bounds_cache = {ri: (int(barr[ri, 0]), int(barr[ri, 1]))
+                                  for ri in range(nrings)}
         self._route_cache = {}
         self._route_cache_hits = 0
         vid_failed_counts: Dict[int, int] = {}
@@ -734,6 +841,65 @@ class ClusterRunner:
             vid_failed_counts[v_of] = vid_failed_counts.get(v_of, 0) + 1
         prev_vid = None
         tp = _clock("restore", t0)
+
+        # ---- phase A: determinant metadata for ALL failed subtasks ----
+        # Dispatch every per-subtask parse/meta program up front, then pay
+        # at most ONE host read for the whole failure set. Subtasks whose
+        # cleanness the host can derive itself (no async rows since the
+        # fence — executor.async_counts ledger — and fence log heads in
+        # hand) skip even that: their metadata becomes deferred asserts
+        # in the final packed read, and their replay defers its sync too.
+        # On a tunneled device the round-trips ARE the warm recovery cost
+        # (~100ms each vs a 133ms replay — r4's protocol bottleneck).
+        ck_heads = self._ck_log_heads.get(ckpt.checkpoint_id)
+        from clonos_tpu.api.operators import HostFeedSource
+        prep: Dict[int, Dict[str, Any]] = {}
+        slow_reads: List[Tuple[int, str, Any]] = []
+        for flat in failed:
+            vid_a, _sub_a = self._vertex_of(flat)
+            v_a = self.job.vertices[vid_a]
+            holders_a = [
+                (r, h) for r, (o, h) in enumerate(self.plan.pairs)
+                if o == flat and h not in self.failed]
+            p: Dict[str, Any] = {"holders": holders_a}
+            eligible = (bool(holders_a) and n_steps > 0
+                        and v_a.operator.replay_pad_safe
+                        and not isinstance(v_a.operator, HostFeedSource)
+                        and n_steps <= self._pad_steps())
+            if eligible:
+                t_d, r_d, e_d, small_d = self._device_parse_fn()(
+                    patched.replicas,
+                    jnp.asarray(holders_a[0][0], jnp.int32),
+                    jnp.asarray(from_epoch, jnp.int32))
+                p["det_device"] = (t_d, r_d, e_d)
+                p["small_d"] = small_d
+            if holders_a:
+                hidx_a = jnp.asarray([r for r, _ in holders_a], jnp.int32)
+                p["meta_d"] = self._fetch_meta_fn(len(holders_a))(
+                    patched.replicas, hidx_a,
+                    jnp.asarray(from_epoch, jnp.int32))
+            p["fast"] = (eligible and ck_heads is not None
+                         and vid_a not in self.txn_logs
+                         and self.executor.async_rows_since(
+                             flat, from_epoch) == 0)
+            if not p["fast"]:
+                if "small_d" in p:
+                    slow_reads.append((flat, "small", p["small_d"]))
+                if "meta_d" in p:
+                    slow_reads.append((flat, "meta", p["meta_d"]))
+            prep[flat] = p
+        slow_vals: Dict[Tuple[int, str], np.ndarray] = {}
+        if slow_reads:
+            packed_a = np.asarray(jnp.concatenate(
+                [d.reshape(-1).astype(jnp.int32)
+                 for _f, _k, d in slow_reads]))
+            off_a = 0
+            for flat, kind, d in slow_reads:
+                nsz = int(np.prod(d.shape))
+                slow_vals[(flat, kind)] = packed_a[
+                    off_a: off_a + nsz].reshape(d.shape)
+                off_a += nsz
+        tp = _clock("fetch_determinants", tp)
 
         for flat in failed:
             vid, sub = self._vertex_of(flat)
@@ -774,10 +940,13 @@ class ClusterRunner:
             for e in out_edges:
                 mgr.notify_new_output_channel(e)
 
-            # DeterminantRequest flood to surviving holders of this log.
-            holders = [
-                (r, h) for r, (o, h) in enumerate(self.plan.pairs)
-                if o == flat and h not in self.failed]
+            # DeterminantRequest flood to surviving holders of this log
+            # (programs were dispatched in phase A; values arrive either
+            # from the phase-A packed read or — fast path — as deferred
+            # asserts in the final one).
+            p = prep[flat]
+            holders = p["holders"]
+            fast = p["fast"]
             synthesized = False
             if not holders and n_steps > 0:
                 if out_edges:
@@ -795,35 +964,34 @@ class ClusterRunner:
             r_best = None
             det_device = None
             clean_n = None
-            if holders:
-                # One device call for every holder's (count, start); the
-                # holders are bit-identical replicas by construction, so
+            if fast:
+                # Host-derived cleanness: zero async rows since the fence
+                # means the log holds exactly n_steps k-row sync blocks
+                # starting at the checkpointed head. Everything the old
+                # metadata read returned is therefore known here; the
+                # device parse/meta values become deferred asserts.
+                ck_head_f = int(ck_heads[flat])
+                det_device = p["det_device"]
+                clean_n, clean_start = DETS_PER_STEP * n_steps, ck_head_f
+                r_best = holders[0][0]
+                mgr.expect_determinant_responses(1)
+                mgr.notify_determinant_response(
+                    np.zeros((0, det.NUM_LANES), np.int32), clean_start)
+            elif holders:
+                # Holders are bit-identical replicas by construction, so
                 # when their metadata agrees the merge is "pull one body"
                 # (saves H-1 multi-MB transfers + 2(H-1) round-trips).
-                hidx = jnp.asarray([r for r, _ in holders], jnp.int32)
-                meta = np.asarray(self._fetch_meta_fn(len(holders))(
-                    patched.replicas, hidx,
-                    jnp.asarray(from_epoch, jnp.int32)))
+                meta = slow_vals[(flat, "meta")]
                 consistent = (len(np.unique(meta[:, 0])) == 1
                               and len(np.unique(meta[:, 1])) == 1)
-                # Clean fast path: parse the consistent replica ON
-                # DEVICE; if the stream is pure sync rows the multi-MB
-                # body never crosses the host link (restore copies it
-                # device-side too). Any irregularity (async rows, layout
-                # drift, step mismatch) falls back to the host path.
-                from clonos_tpu.api.operators import HostFeedSource
-                if consistent and n_steps > 0 \
-                        and v.operator.replay_pad_safe \
-                        and not isinstance(v.operator, HostFeedSource) \
-                        and n_steps <= self._pad_steps():
-                    t_d, r_d, e_d, small = self._device_parse_fn()(
-                        patched.replicas,
-                        jnp.asarray(holders[0][0], jnp.int32),
-                        jnp.asarray(from_epoch, jnp.int32))
+                # Clean path off the ledger fast lane: the device parse
+                # (phase A) says whether the stream is pure sync rows; if
+                # so the multi-MB body never crosses the host link.
+                if consistent and (flat, "small") in slow_vals:
                     cnt_s, start_s, nanch, cleanflag = (
-                        int(x) for x in np.asarray(small))
+                        int(x) for x in slow_vals[(flat, "small")])
                     if cleanflag and nanch == n_steps:
-                        det_device = (t_d, r_d, e_d)
+                        det_device = p["det_device"]
                         clean_n, clean_start = cnt_s, start_s
                         mgr.expect_determinant_responses(1)
                         mgr.notify_determinant_response(
@@ -848,7 +1016,8 @@ class ClusterRunner:
                 mgr.expect_determinant_responses(0)
             if synthesized:
                 rows = self._synthesize_det_rows(fence, n_steps)
-                start = int(np.asarray(snap.log_heads[flat]))
+                start = (int(ck_heads[flat]) if ck_heads is not None
+                         else int(np.asarray(snap.log_heads[flat])))
             elif det_device is not None:
                 rows = np.zeros((0, det.NUM_LANES), np.int32)
                 start = clean_start
@@ -876,8 +1045,6 @@ class ClusterRunner:
                                                   sub, fence, n_steps)
             elif isinstance(v.operator, HostFeedSource) and n_steps > 0:
                 input_steps = self._reread_feed(vid, sub, snap, rows, n_steps)
-            if input_steps is not None:
-                jax.block_until_ready(input_steps)
             tp = _clock("inputs", tp)
 
             plan = rec.ReplayPlan(
@@ -887,8 +1054,11 @@ class ClusterRunner:
                 checkpoint_op_state=snap.op_states[vid],
                 n_steps=n_steps, verify_outputs=not synthesized,
                 det_device=det_device)
-            result = mgr.run_replay(plan)
-            total_records += result.records_replayed
+            # Fast path: replay dispatches only — output-cut verification
+            # and the consumed total ride the final packed read.
+            result = mgr.run_replay(plan, defer_sync=fast)
+            if not result.deferred:
+                total_records += result.records_replayed
             # Re-fire recovered timer effects (rows are already spliced
             # into the rebuilt log; only the callback side-effects re-run —
             # reference LogReplayerImpl.triggerAsyncEvent:102).
@@ -925,7 +1095,10 @@ class ClusterRunner:
                                   result, rebuilt, from_epoch, fence,
                                   n_steps, replica_src=r_best,
                                   det_n=clean_n,
-                                  clean_sync=det_device is not None)
+                                  clean_sync=det_device is not None,
+                                  ck_head=(int(ck_heads[flat])
+                                           if ck_heads is not None
+                                           else None))
             tp = _clock("patch", tp)
 
         # Replica rows held by revived subtasks: replicas are identical to
@@ -952,9 +1125,95 @@ class ClusterRunner:
         self.executor.carry = patched
         self._bounds_cache = None
         self._route_cache = {}     # free the held routed device buffers
-        from clonos_tpu.utils.devsync import device_sync
-        device_sync(patched)
         tp = _clock("replica_rebuild", tp)
+
+        # ---- final packed read: completion barrier + deferred asserts ----
+        # ONE device->host transfer closes the protocol: the restored log
+        # heads (graft landed), the ring bounds recovery routed against,
+        # and for every fast-path subtask its parse/meta metadata, its
+        # on-device output-cut verification flag, and its consumed total.
+        # TPU programs execute in dispatch order, so this read — dispatched
+        # last — is also the barrier the old device_sync(patched) was.
+        fast_mgrs = [m for m in managers if prep[m.flat_subtask]["fast"]]
+        fl_d = jnp.asarray(list(failed), jnp.int32)
+        pieces = [patched.logs.head[fl_d].astype(jnp.int32)]
+        if nrings:
+            pieces.append(bounds_dev.reshape(-1).astype(jnp.int32))
+        for m in fast_mgrs:
+            pf = prep[m.flat_subtask]
+            pieces += [
+                pf["small_d"].astype(jnp.int32),
+                pf["meta_d"].reshape(-1).astype(jnp.int32),
+                m.result.verify_ok_d.astype(jnp.int32).reshape(1),
+                m.result.consumed_d.astype(jnp.int32).reshape(1)]
+        arr_f = np.asarray(jnp.concatenate(pieces))
+        off_f = len(failed)
+        heads_after = arr_f[:off_f]
+        if nrings:
+            bounds_np = arr_f[off_f: off_f + nrings * 2].reshape(nrings, 2)
+            off_f += nrings * 2
+            if self._ring_mirror_valid:
+                for ri in range(nrings):
+                    want = (self._ring_tail_mirror,
+                            self.executor._steps_executed)
+                    got = (int(bounds_np[ri, 0]), int(bounds_np[ri, 1]))
+                    if got != want:
+                        raise rec.RecoveryError(
+                            f"ring {ri}: host bound mirror {want} diverges "
+                            f"from device bounds {got} — recovery routed "
+                            f"against wrong coverage; state suspect")
+        want_n = DETS_PER_STEP * n_steps
+        for m in fast_mgrs:
+            flat_m = m.flat_subtask
+            pf = prep[flat_m]
+            ck_head_m = int(ck_heads[flat_m])
+            small_np = arr_f[off_f: off_f + 4]
+            off_f += 4
+            nh = len(pf["holders"])
+            meta_np = arr_f[off_f: off_f + 2 * nh].reshape(nh, 2)
+            off_f += 2 * nh
+            ok_f = int(arr_f[off_f])
+            consumed_f = int(arr_f[off_f + 1])
+            off_f += 2
+            if (tuple(int(x) for x in small_np)
+                    != (want_n, ck_head_m, n_steps, 1)):
+                raise rec.RecoveryError(
+                    f"subtask {flat_m}: host-derived clean stream "
+                    f"(n={want_n}, start={ck_head_m}, anchors={n_steps}) "
+                    f"contradicted by device parse "
+                    f"{[int(x) for x in small_np]} — async-row ledger or "
+                    f"fence-head cache is wrong; state suspect")
+            for j in range(nh):
+                if (int(meta_np[j, 0]), int(meta_np[j, 1])) \
+                        != (want_n, ck_head_m):
+                    raise rec.RecoveryError(
+                        f"subtask {flat_m}: replica holder {j} metadata "
+                        f"{meta_np[j].tolist()} disagrees with "
+                        f"({want_n}, {ck_head_m}) — replicas inconsistent")
+            if int(heads_after[list(failed).index(flat_m)]) \
+                    != ck_head_m + want_n:
+                raise rec.RecoveryError(
+                    f"subtask {flat_m}: restored log head "
+                    f"{int(heads_after[list(failed).index(flat_m)])} != "
+                    f"fence head {ck_head_m} + {want_n} rows")
+            if not ok_f:
+                # Resolve the device arrays and let verify() build the
+                # detailed divergence message (failure path: the extra
+                # transfer is fine).
+                m.result.emit_counts = np.asarray(m.result.emit_counts)
+                m.result.expected_emits = np.asarray(
+                    m.result.expected_emits)
+                try:
+                    m.result.verify()
+                except rec.RecoveryError as err:
+                    raise rec.RecoveryError(
+                        f"subtask {flat_m}: {err}") from None
+                raise rec.RecoveryError(
+                    f"subtask {flat_m}: device verify flag tripped but "
+                    f"host recheck passed — flag/stream mismatch")
+            m.result.records_replayed = consumed_f
+            total_records += consumed_f
+        tp = _clock("finalize", tp)
         for flat in failed:
             self.heartbeats.revive(flat)
         self.failed.clear()
@@ -1056,30 +1315,28 @@ class ClusterRunner:
                 src_cap = compiled.vertex_out_capacity(e.src)
                 ri = compiled.ring_index[e.src]
                 el = carry.out_rings[ri]
-                for m in (ch - 1, ch):
-                    if m <= 0:
-                        continue
-                    self._ring_chunk_fn(ri, m)(el, jnp.asarray(0, jnp.int32))
-                    z = jnp.asarray(0, jnp.int32)
-                    # Both variants: fused lane (single failure) and
-                    # all-lane + select (connected-failure sharing).
-                    self._route_chunk_fn(eidx, m)(el, z, z, z, z)
-                    routed, *_ = self._route_chunk_fn(
-                        eidx, m, all_lanes=True)(el, z, z, z)
-                    self._lane_select_fn(eidx, m)(routed, z)
-                    if spill_paths:
-                        # Spill-path twin (AVAILABILITY wrap recovery):
-                        # doubles the exchange compiles, so opt-in — a
-                        # ring-covered recovery (the common case) never
-                        # takes this path. Both variants, like the ring
-                        # route above.
-                        self._route_raw_fn(eidx, m)(
-                            zero_batch((m, src_p, src_cap)), z, z, z, z)
-                        self._route_raw_fn(eidx, m, all_lanes=True)(
-                            zero_batch((m, src_p, src_cap)), z, z, z)
+                z = jnp.asarray(0, jnp.int32)
+                # Uniform [ch] replay windows: ONE shape per edge (the
+                # old first-chunk ch-1 variants doubled these compiles).
+                # Both routing variants: fused lane (single failure) and
+                # all-lane + select (connected-failure sharing).
+                self._route_chunk_fn(eidx, ch)(el, z, z, z, z, z)
+                routed, *_ = self._route_chunk_fn(
+                    eidx, ch, all_lanes=True)(el, z, z, z, z)
+                self._lane_select_fn(eidx, ch)(routed, z)
+                if spill_paths:
+                    # Spill-path twin (AVAILABILITY wrap recovery):
+                    # doubles the exchange compiles, so opt-in — a
+                    # ring-covered recovery (the common case) never
+                    # takes this path.
+                    self._ring_chunk_fn(ri, ch)(el, z)
+                    self._route_raw_fn(eidx, ch)(
+                        zero_batch((ch, src_p, src_cap)), z, z, z, z, z)
+                    self._route_raw_fn(eidx, ch, all_lanes=True)(
+                        zero_batch((ch, src_p, src_cap)), z, z, z, z)
                 self._first_chunk_fn(eidx)(
                     zero_batch((1, e.capacity)),
-                    zero_batch((ch - 1, e.capacity)))
+                    zero_batch((ch, e.capacity)))
             # Replay block program(s).
             slot_keys = compiled.consumer_slot_keys(vid)
             subs = range(v.parallelism) if slot_keys is not None else [0]
@@ -1334,37 +1591,56 @@ class ClusterRunner:
         else:
             tail, head = int(el.tail), int(el.head)
         ring_lo = max(tail, head - el.ring_steps)
-        # Loop state lives ON DEVICE (a host scalar put per chunk costs a
-        # tunnel round-trip); coverage decisions use the host bounds.
-        start_d = jnp.asarray(fence, jnp.int32)
+        # Uniform [ch] windows: window i covers absolute steps
+        # [fence-1+i*ch, fence-1+(i+1)*ch). Window slot j (global) holds
+        # step fence-1+j; slot 0 is dead (pre-fence) — masked by ``lead``
+        # and replaced with the checkpointed edge buffer. One compiled
+        # program per edge serves every chunk (prewarm halved vs the old
+        # first-chunk (ch-1) shape variants). Loop state lives ON DEVICE
+        # (a host scalar put per chunk costs a tunnel round-trip);
+        # coverage decisions use the host bounds.
+        start_d = jnp.asarray(fence - 1, jnp.int32)
         sub_d = jnp.asarray(sub, jnp.int32)
         rr_d = jnp.asarray(snap.rr_offsets[eidx][0], jnp.int32)
-        need_d = jnp.asarray(n_steps - 1, jnp.int32)
+        need_d = jnp.asarray(n_steps, jnp.int32)
+        lead_d = jnp.asarray(1, jnp.int32)
         chunks = []
         nblocks = -(-n_steps // ch)
         for i in range(nblocks):
-            m = ch - 1 if i == 0 else ch
-            h_start = fence if i == 0 else fence + i * ch - 1
-            h_need = (min(n_steps - 1, m) if i == 0
-                      else min(n_steps, (i + 1) * ch) - i * ch)
-            if m == 0:
-                chunks.append(first)
-                continue
-            covered = (h_start >= ring_lo and h_start >= tail
-                       and head - h_start >= h_need)
+            h_start = fence - 1 + i * ch
+            # Real ring steps this window must provide (its live slots).
+            lo_real = max(h_start, fence)
+            hi_real = min(h_start + ch, fence - 1 + n_steps)
+            h_need = max(hi_real - lo_real, 0)
+            covered = (lo_real >= ring_lo and lo_real >= tail
+                       and head - lo_real >= h_need)
             share = self._route_cache_enabled
+
+            def raw_window():
+                # Spill-backed window, shaped like the ring window: pull
+                # the real steps from ring+spill and shift window 0 down
+                # one slot (its dead leading slot carries no step).
+                raw = self._ring_steps(patched, e.src, lo_real, ch,
+                                       need=h_need)
+                if i == 0:
+                    raw = jax.tree_util.tree_map(
+                        lambda x: jnp.roll(x, 1, axis=0).at[0].set(
+                            jnp.zeros_like(x[0])), raw)
+                return raw
+
             if not share:
                 # Single failed consumer: the fused variant scatters only
                 # this lane's rows (~P times cheaper than materializing
                 # the whole routed block).
                 if covered:
-                    lane, start_d, rr_d, need_d = self._route_chunk_fn(
-                        eidx, m)(el, start_d, sub_d, rr_d, need_d)
+                    lane, start_d, rr_d, need_d, lead_d = \
+                        self._route_chunk_fn(eidx, ch)(
+                            el, start_d, sub_d, rr_d, need_d, lead_d)
                 else:
-                    raw = self._ring_steps(patched, e.src, h_start, m,
-                                           need=h_need)
-                    lane, start_d, rr_d, need_d = self._route_raw_fn(
-                        eidx, m)(raw, start_d, sub_d, rr_d, need_d)
+                    lane, start_d, rr_d, need_d, lead_d = \
+                        self._route_raw_fn(eidx, ch)(
+                            raw_window(), start_d, sub_d, rr_d, need_d,
+                            lead_d)
             else:
                 # Multiple failed consumers: route the window once to all
                 # lanes, cache it, and lane-select per consumer
@@ -1373,20 +1649,19 @@ class ClusterRunner:
                 cached = self._route_cache.get(key)
                 if cached is None:
                     if covered:
-                        routed, start_d, rr_d, need_d = \
-                            self._route_chunk_fn(eidx, m, all_lanes=True)(
-                                el, start_d, rr_d, need_d)
+                        routed, start_d, rr_d, need_d, lead_d = \
+                            self._route_chunk_fn(eidx, ch, all_lanes=True)(
+                                el, start_d, rr_d, need_d, lead_d)
                     else:
-                        raw = self._ring_steps(patched, e.src, h_start, m,
-                                               need=h_need)
-                        routed, start_d, rr_d, need_d = \
-                            self._route_raw_fn(eidx, m, all_lanes=True)(
-                                raw, start_d, rr_d, need_d)
+                        routed, start_d, rr_d, need_d, lead_d = \
+                            self._route_raw_fn(eidx, ch, all_lanes=True)(
+                                raw_window(), start_d, rr_d, need_d,
+                                lead_d)
                     self._route_cache[key] = routed
                 else:
                     routed = cached
                     self._route_cache_hits += 1
-                lane = self._lane_select_fn(eidx, m)(routed, sub_d)
+                lane = self._lane_select_fn(eidx, ch)(routed, sub_d)
             if i == 0:
                 chunks.append(self._first_chunk_fn(eidx)(first, lane))
             else:
@@ -1554,8 +1829,8 @@ class ClusterRunner:
                sub: int, flat: int, result: rec.ReplayResult,
                det_rows: np.ndarray, from_epoch: int, fence: int,
                n_steps: int, replica_src: Optional[int] = None,
-               det_n: Optional[int] = None, clean_sync: bool = False
-               ) -> JobCarry:
+               det_n: Optional[int] = None, clean_sync: bool = False,
+               ck_head: Optional[int] = None) -> JobCarry:
         """Graft the rebuilt subtask back into the live carry. Every
         device program here is fixed-shape (chunked appends/writes) so a
         prewarmed standby pays zero XLA compile on the failure path.
@@ -1566,7 +1841,8 @@ class ClusterRunner:
         its device-verified row count."""
         compiled = self.executor.compiled
         ch4 = self._chunk() * DETS_PER_STEP
-        ck_head = int(np.asarray(snap.log_heads[flat]))
+        if ck_head is None:
+            ck_head = int(np.asarray(snap.log_heads[flat]))
         n = det_rows.shape[0] if det_n is None else det_n
         # Epoch->offset index entries died with the task; rebuild them from
         # the fence-step ledger. Sync blocks anchor at TIMESTAMP rows.
@@ -1632,7 +1908,11 @@ class ClusterRunner:
                 jnp.asarray(latest, jnp.int32),
                 jnp.asarray(from_epoch, jnp.int32))
         # Operator state slice + log row + record count in one program.
-        rc = snap.record_counts[flat] + result.records_replayed
+        # Deferred replays keep the consumed total on device — the add
+        # happens there and the host never waits for it.
+        rc = snap.record_counts[flat] + (
+            result.consumed_d if result.deferred
+            else result.records_replayed)
         carry = self._graft_fn(vid)(
             carry, result.op_state, restored,
             jnp.asarray(sub, jnp.int32), jnp.asarray(flat, jnp.int32), rc)

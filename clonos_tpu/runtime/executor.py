@@ -555,6 +555,14 @@ class LocalExecutor:
         #: this when re-deriving epoch start offsets from TIMESTAMP
         #: anchors (the rows belong to the NEW epoch).
         self.roll_gap_async: Dict[Tuple[int, int], int] = {}
+        #: (flat, epoch) -> ALL async rows appended to that task's log
+        #: during that epoch. A host-side mirror of log cleanness: a task
+        #: with zero async rows since an epoch fence has a pure k-row
+        #: sync-block stream there (only the block program appended), so
+        #: recovery can take the device-resident clean path WITHOUT a
+        #: metadata round-trip — the device parse still validates it, but
+        #: as a deferred assert folded into recovery's final read.
+        self.async_counts: Dict[Tuple[int, int], int] = {}
         #: supersteps actually executed (the staged epoch path pre-fills
         #: step_input_history, so len(history) over-counts mid-epoch).
         self._steps_executed = 0
@@ -854,6 +862,8 @@ class LocalExecutor:
                                       if e > epoch]
         self.roll_gap_async = {k: v for k, v in self.roll_gap_async.items()
                                if k[1] > epoch}
+        self.async_counts = {k: v for k, v in self.async_counts.items()
+                             if k[1] > epoch}
 
     def _health_vector(self, carry: JobCarry) -> jnp.ndarray:
         """Pure: packed int32 [3 + num_rings + 1 + 1] health flags + total
@@ -876,8 +886,12 @@ class LocalExecutor:
         else:
             flags.append(jnp.zeros((), jnp.bool_))
         vec = jnp.stack([f.astype(jnp.int32) for f in flags])
+        # Trailing: total record count, then the per-task log heads — at
+        # an epoch fence these ARE the checkpoint's log heads, so the
+        # control plane learns them inside the one read it already pays
+        # (recovery's patch phase then needs no head round-trip).
         return jnp.concatenate(
-            [vec, carry.record_counts.sum()[None]])
+            [vec, carry.record_counts.sum()[None], carry.logs.head])
 
     def health_vector(self) -> np.ndarray:
         if not hasattr(self, "_jit_health"):
@@ -953,6 +967,9 @@ class LocalExecutor:
             for f in flat_subtasks:
                 k = (f, self.epoch_id)
                 self.roll_gap_async[k] = self.roll_gap_async.get(k, 0) + 1
+        for f in flat_subtasks:
+            k = (f, self.epoch_id)
+            self.async_counts[k] = self.async_counts.get(k, 0) + 1
         rows1 = np.zeros((self.compiled.L, det.NUM_LANES), np.int32)
         counts = np.zeros((self.compiled.L,), np.int32)
         rows1[list(flat_subtasks)] = row
@@ -968,6 +985,12 @@ class LocalExecutor:
     def global_record_stamp(self) -> int:
         """Monotone nonzero stamp for async rows (1 + supersteps run)."""
         return self._steps_executed + 1
+
+    def async_rows_since(self, flat_subtask: int, from_epoch: int) -> int:
+        """How many async determinant rows this task's log holds in epochs
+        >= ``from_epoch`` (host ledger — no device read)."""
+        return sum(v for (f, e), v in self.async_counts.items()
+                   if f == flat_subtask and e >= from_epoch)
 
     def service_factory(self, flat_subtask: int,
                         sidecar: "det.SidecarStore",

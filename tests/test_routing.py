@@ -218,3 +218,40 @@ def test_forward_identity():
     assert int(dropped.sum()) == 0
     np.testing.assert_array_equal(np.asarray(routed.keys),
                                   np.asarray(batch.keys))
+
+
+@pytest.mark.parametrize("cap,K,P,B", [
+    (4, 7, 3, 16), (16, 7, 3, 16), (64, 5, 8, 600),
+])
+def test_lane_routes_bit_identical_to_full_route_lane(cap, K, P, B):
+    """The single-lane exchange (recovery's fused single-failure path)
+    must equal the full block route's lane slice bit-for-bit — survivors,
+    positions, overflow drops, everything."""
+    rng = np.random.RandomState(11)
+    batch = _rand_block(rng, K, P, B)
+    T, G = 3, 8
+    full, _ = routing.route_hash_block(batch, T, G, cap)
+    for lane in range(T):
+        got = routing.route_hash_block_lane(batch, lane, T, G, cap)
+        for a, b in zip(got, full):
+            np.testing.assert_array_equal(np.asarray(a),
+                                          np.asarray(b[:, lane]))
+    offs = jnp.asarray(rng.randint(0, 5, size=(K,)), jnp.int32)
+    full_rb, _ = routing.route_rebalance_block(batch, T, cap, offs)
+    for lane in range(T):
+        got = routing.route_rebalance_block_lane(batch, lane, T, cap, offs)
+        for a, b in zip(got, full_rb):
+            np.testing.assert_array_equal(np.asarray(a),
+                                          np.asarray(b[:, lane]))
+    full_bc, _ = routing.route_broadcast_block(batch, T, cap)
+    for lane in range(T):
+        got = routing.route_broadcast_block_lane(batch, lane, cap)
+        for a, b in zip(got, full_bc):
+            np.testing.assert_array_equal(np.asarray(a),
+                                          np.asarray(b[:, lane]))
+    full_fw, _ = routing.route_forward_block(batch, cap)
+    for lane in range(P):
+        got = routing.route_forward_block_lane(batch, lane, cap)
+        for a, b in zip(got, full_fw):
+            np.testing.assert_array_equal(np.asarray(a),
+                                          np.asarray(b[:, lane]))

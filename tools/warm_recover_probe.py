@@ -1,0 +1,72 @@
+#!/usr/bin/env python
+"""Measure warm recovery wall + phase breakdown at full bench shapes
+WITHOUT the 4-minute prewarm: pay one cold recover (compiles the failure
+path), then repeat inject+recover to see the steady-state protocol cost.
+Set PROBE_FILL to try larger replay spans."""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+    import bench
+    from clonos_tpu.runtime.cluster import ClusterRunner
+    from clonos_tpu.runtime.executor import DETS_PER_STEP
+    from clonos_tpu.utils.devsync import device_sync
+
+    fill = int(os.environ.get("PROBE_FILL", bench.FILL_EPOCHS))
+    SPE = bench.STEPS_PER_EPOCH
+    job = bench.build_job()
+    need = fill * SPE * DETS_PER_STEP
+    cap = 1 << need.bit_length()
+    span = fill * SPE
+    ring = 1 << (span - 1).bit_length()   # exactly the fill span
+    print("fill:", fill, "ring_steps:", ring, "log_cap:", cap, flush=True)
+    runner = ClusterRunner(job, steps_per_epoch=SPE, log_capacity=cap,
+                           max_epochs=16, inflight_ring_steps=ring,
+                           recovery_block_steps=8192, block_steps=1024,
+                           seed=7)
+    t0 = time.monotonic()
+    runner.run_epoch(complete_checkpoint=True)
+    device_sync(runner.executor.carry)
+    print("epoch0:", round(time.monotonic() - t0, 1), "s", flush=True)
+    t0 = time.monotonic()
+    for _ in range(fill):
+        runner.run_epoch(complete_checkpoint=False)
+    device_sync(runner.executor.carry)
+    print("fill:", round(time.monotonic() - t0, 1), "s", flush=True)
+
+    failed = bench.PAR + 1
+    runner.inject_failure([failed])
+    t0 = time.monotonic()
+    report = runner.recover()
+    print("cold recover:", round(time.monotonic() - t0, 1), "s",
+          {k: round(v, 1) for k, v in report.phase_ms.items()}, flush=True)
+
+    for trial in range(4):
+        runner.inject_failure([failed])
+        t0 = time.monotonic()
+        rep = runner.recover()
+        device_sync(runner.executor.carry)
+        print(f"warm recover #{trial}: "
+              f"{(time.monotonic() - t0) * 1e3:.1f}ms phases:",
+              {k: round(v, 1) for k, v in rep.phase_ms.items()}, flush=True)
+
+    # warm replay alone (the vs_baseline measurement)
+    mgr = report.managers[0]
+    for trial in range(5):
+        t1 = time.monotonic()
+        result = mgr.replayer.replay(mgr.plan)
+        device_sync(result.emit_counts)
+        print(f"warm replay #{trial}: "
+              f"{(time.monotonic() - t1) * 1e3:.1f}ms "
+              f"records={result.records_replayed}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
