@@ -97,6 +97,58 @@ def cmd_dryrun(args) -> int:
     return 0
 
 
+def cmd_worker(args) -> int:
+    """TaskExecutor-process entrypoint (reference TaskExecutor.java:422):
+    run a job under a remote JobMaster — register + heartbeat, serve the
+    determinant logs to standby-host mirrors at every epoch fence, and
+    write durable checkpoints the JobMaster can rebuild from after this
+    host dies. One JSON status line per epoch on stdout."""
+    from clonos_tpu.parallel import distributed
+    from clonos_tpu.runtime.cluster import ClusterRunner
+    from clonos_tpu.runtime.remote import (HostLogEndpoint,
+                                           TaskExecutorClient)
+
+    ctx = distributed.initialize(args.coordinator, args.num_processes,
+                                 args.process_id)
+    job = _load_job(args.job)
+    runner = ClusterRunner(job, steps_per_epoch=args.steps_per_epoch,
+                           checkpoint_dir=args.checkpoint_dir,
+                           seed=args.seed)
+    endpoint = HostLogEndpoint(runner.executor, host=args.bind_host)
+    host, _, port = args.jm.partition(":")
+    tx = TaskExecutorClient(
+        args.executor_id, (host, int(port)),
+        interval_s=args.heartbeat_interval,
+        info={"log_host": args.advertise_host or args.bind_host,
+              "log_port": endpoint.address[1],
+              "num_subtasks": job.total_subtasks(),
+              "checkpoint_dir": args.checkpoint_dir, "job": args.job,
+              "process_id": ctx.process_id})
+    print(json.dumps({"registered": args.executor_id,
+                      "log_port": endpoint.address[1],
+                      "subtasks": job.total_subtasks()}), flush=True)
+    try:
+        for i in range(args.epochs):
+            runner.run_epoch(
+                complete_checkpoint=(i % args.complete_every == 0))
+            # Status BEFORE the endpoint refresh: a mirror can then never
+            # hold a fence whose digest was not yet reported (watchers
+            # key their cross-process bit-identity checks on these
+            # lines; a kill between the two leaves the mirror one fence
+            # behind the last report, never ahead).
+            print(json.dumps({"epoch": runner.executor.epoch_id,
+                              "global_step": runner.global_step,
+                              "digest": runner.state_digest()}),
+                  flush=True)
+            endpoint.refresh()         # fence snapshot for the mirrors
+            if args.epoch_sleep:
+                time.sleep(args.epoch_sleep)
+    finally:
+        tx.close()
+        endpoint.close()
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="clonos_tpu")
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -121,6 +173,35 @@ def main(argv=None) -> int:
     pd = sub.add_parser("dryrun", help="multichip sharding dry run")
     pd.add_argument("--devices", type=int, default=8)
     pd.set_defaults(fn=cmd_dryrun)
+
+    pw = sub.add_parser("worker", help="run a job as a TaskExecutor "
+                                       "process under a remote JobMaster")
+    pw.add_argument("job", help="module:function returning a JobGraph")
+    pw.add_argument("--jm", required=True, help="JobMaster host:port")
+    pw.add_argument("--executor-id", default="worker-0")
+    pw.add_argument("--checkpoint-dir", required=True)
+    pw.add_argument("--epochs", type=int, default=8)
+    pw.add_argument("--steps-per-epoch", type=int, default=16)
+    pw.add_argument("--complete-every", type=int, default=4,
+                    help="complete (ack) every k-th checkpoint; others "
+                         "stay pending (the large-interval regime)")
+    pw.add_argument("--seed", type=int, default=0)
+    pw.add_argument("--heartbeat-interval", type=float, default=0.5)
+    pw.add_argument("--epoch-sleep", type=float, default=0.0,
+                    help="pause between epochs (lets tests kill mid-run)")
+    pw.add_argument("--bind-host", default="127.0.0.1",
+                    help="interface the determinant-log endpoint binds "
+                         "(use the host's fabric address for cross-host "
+                         "mirroring)")
+    pw.add_argument("--advertise-host", default=None,
+                    help="address mirrors should dial (defaults to "
+                         "--bind-host)")
+    pw.add_argument("--coordinator", default=None,
+                    help="jax.distributed coordinator address "
+                         "(multi-host bootstrap)")
+    pw.add_argument("--num-processes", type=int, default=None)
+    pw.add_argument("--process-id", type=int, default=None)
+    pw.set_defaults(fn=cmd_worker)
 
     args = p.parse_args(argv)
     return args.fn(args)

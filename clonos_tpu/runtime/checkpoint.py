@@ -68,12 +68,31 @@ class CheckpointStorage:
     def list_ids(self) -> List[int]:
         raise NotImplementedError
 
+    def _path(self, checkpoint_id: int) -> str:
+        raise NotImplementedError
+
+    def mark_complete(self, checkpoint_id: int) -> None:
+        """Durable completion marker: snapshots are WRITTEN at trigger,
+        but only fully-acked checkpoints are restore points — a standby
+        host must be able to tell them apart from the storage alone
+        (reference: the coordinator's completed-checkpoint store).
+        Shared file-marker implementation for ``_path``-based storages;
+        delete() implementations must remove the marker with the
+        snapshot."""
+        with open(self._path(checkpoint_id) + ".done", "wb"):
+            pass
+
+    def completed_ids(self) -> List[int]:
+        return sorted(c for c in self.list_ids()
+                      if os.path.exists(self._path(c) + ".done"))
+
 
 class InMemoryCheckpointStorage(CheckpointStorage):
     wants_host = False
 
     def __init__(self):
         self._store: Dict[int, CompletedCheckpoint] = {}
+        self._complete: set = set()
 
     def write(self, ckpt: CompletedCheckpoint) -> None:
         self._store[ckpt.checkpoint_id] = ckpt
@@ -83,9 +102,16 @@ class InMemoryCheckpointStorage(CheckpointStorage):
 
     def delete(self, checkpoint_id: int) -> None:
         self._store.pop(checkpoint_id, None)
+        self._complete.discard(checkpoint_id)
 
     def list_ids(self) -> List[int]:
         return sorted(self._store)
+
+    def mark_complete(self, checkpoint_id: int) -> None:
+        self._complete.add(checkpoint_id)
+
+    def completed_ids(self) -> List[int]:
+        return sorted(self._complete & set(self._store))
 
 
 class FileCheckpointStorage(CheckpointStorage):
@@ -110,10 +136,12 @@ class FileCheckpointStorage(CheckpointStorage):
             return pickle.load(f)
 
     def delete(self, checkpoint_id: int) -> None:
-        try:
-            os.remove(self._path(checkpoint_id))
-        except OSError:
-            pass
+        for p in (self._path(checkpoint_id),
+                  self._path(checkpoint_id) + ".done"):
+            try:
+                os.remove(p)
+            except OSError:
+                pass
 
     def list_ids(self) -> List[int]:
         out = []
@@ -246,6 +274,10 @@ class CheckpointCoordinator:
         if checkpoint_id in self._pending:
             del self._pending[checkpoint_id]
             self._completed_ids.append(checkpoint_id)
+            try:
+                self.storage.mark_complete(checkpoint_id)
+            except NotImplementedError:          # custom storages
+                pass
             for fn in self._complete_listeners:
                 fn(checkpoint_id)
             for fn in self._listeners:

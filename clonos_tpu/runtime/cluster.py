@@ -201,6 +201,8 @@ class ClusterRunner:
         #: lazily and by prewarm_recovery() (warm standby: no XLA compile
         #: in the failure path).
         self._rjit: Dict[Any, Any] = {}
+        import threading as _threading
+        self._rjit_lock = _threading.Lock()
         #: routed edge-window cache, scoped to one vertex's failed
         #: subtasks within one recover() call (the exchange output is
         #: consumer-independent; see _replay_inputs). Populated only
@@ -284,8 +286,11 @@ class ClusterRunner:
     def _jitted(self, key, make, donate=()):
         f = self._rjit.get(key)
         if f is None:
-            f = jax.jit(make(), donate_argnums=donate)
-            self._rjit[key] = f
+            with self._rjit_lock:
+                f = self._rjit.get(key)
+                if f is None:
+                    f = jax.jit(make(), donate_argnums=donate)
+                    self._rjit[key] = f
         return f
 
     def _chunk(self) -> int:
@@ -609,6 +614,212 @@ class ClusterRunner:
             D.CHECKPOINT_BACKOFF_MULTIPLIER)
         return runner
 
+    @classmethod
+    def bootstrap_standby(cls, job: JobGraph, checkpoint_dir: str,
+                          mirror_rows: Dict[int, Tuple[np.ndarray, int]],
+                          ignored_checkpoints: Sequence[int] = (),
+                          **runner_kw
+                          ) -> Tuple["ClusterRunner", RecoveryReport]:
+        """Standby-HOST failover: rebuild the ENTIRE job in a fresh
+        process after a whole-host loss, from (a) the durable checkpoint
+        and (b) a RemoteReplicaMirror's determinant rows — the mirrors
+        are the determinant source intra-chip replicas cannot be when
+        the chip died with the host (reference: standby TaskManagers +
+        DeterminantResponseEvent over the wire;
+        RunStandbyTaskStrategy.java:186-227, Task.java:1290).
+
+        Every subtask is recovered through the normal causal protocol in
+        topological order — sources replay from their recorded rng/time
+        streams, their rebuilt in-flight rings feed downstream routing —
+        so the rebuilt cluster's state is bit-identical to the dead
+        worker's at its last mirrored fence, verified by the replay's
+        output-cut asserts against the mirrored BUFFER_BUILT rows.
+
+        Requirements: ``mirror_rows`` must cover every flat subtask and
+        end at an epoch fence (mirrors refresh at fences); rebalance
+        edges are not yet reconstructible (their round-robin cursors are
+        not in the lean snapshot's fence state)."""
+        for e in job.edges:
+            if e.partition == PartitionType.REBALANCE:
+                raise rec.RecoveryError(
+                    "bootstrap_standby: rebalance edges not supported "
+                    "(post-replay round-robin cursors are not "
+                    "reconstructible from the fence snapshot)")
+        runner = cls(job, checkpoint_dir=checkpoint_dir, **runner_kw)
+        storage = runner.coordinator.storage
+        ignored = set(ignored_checkpoints)
+        # Only fully-ACKED checkpoints are restore points; triggered-but-
+        # unacked snapshots also sit in storage (written at the fence).
+        ids = [i for i in storage.completed_ids() if i not in ignored]
+        if not ids:
+            raise rec.RecoveryError(
+                "bootstrap_standby: no durable completed non-ignored "
+                f"checkpoint in {checkpoint_dir}")
+        ckpt = storage.read(max(ids))
+        runner.standbys.on_completed_checkpoint(ckpt)
+        runner.coordinator._ignored.update(ignored)
+        spe = runner.executor.steps_per_epoch
+        from_epoch = ckpt.checkpoint_id + 1
+        L = job.total_subtasks()
+        missing = [f for f in range(L) if f not in mirror_rows]
+        if missing:
+            raise rec.RecoveryError(
+                f"bootstrap_standby: mirror rows missing for subtasks "
+                f"{missing}")
+
+        # The absolute superstep at the fence: the lean snapshot's ring
+        # heads ARE step counts (one append per superstep).
+        fence = (int(np.asarray(ckpt.carry.ring_heads[0]))
+                 if ckpt.carry.ring_heads else 0)
+
+        # Steps replayed = sync-anchor count of the mirrored streams
+        # (lockstep supersteps: every log advances together, and the
+        # mirror snapshot is prefix-consistent across flats).
+        anchors_by_flat: Dict[int, np.ndarray] = {}
+        for flat, (rows, _start) in mirror_rows.items():
+            rows = np.asarray(rows, np.int32)
+            anchors_by_flat[flat] = np.where(
+                (rows[:, det.LANE_TAG] == det.TIMESTAMP)
+                & (rows[:, det.LANE_RC] == 0))[0]
+        ns = {len(a) for a in anchors_by_flat.values()}
+        if len(ns) != 1:
+            raise rec.RecoveryError(
+                f"bootstrap_standby: mirror streams disagree on the "
+                f"replayed step count: {sorted(ns)}")
+        n_steps = ns.pop()
+        if n_steps % spe != 0:
+            raise rec.RecoveryError(
+                f"bootstrap_standby: mirrored {n_steps} steps is not a "
+                f"whole number of {spe}-step epochs (mirrors refresh at "
+                f"fences)")
+        k = n_steps // spe
+
+        # Control-plane bookkeeping the dead worker would have had.
+        runner.global_step = fence + n_steps
+        runner.executor._steps_executed = fence + n_steps
+        # Step-input ledger: per-step (time, rng) inputs are global
+        # across the lockstep supersteps, so any subtask's recorded
+        # stream reproduces them; pre-fence entries are placeholders
+        # (nothing replays below a completed fence).
+        a0 = anchors_by_flat[0]
+        rows0 = np.asarray(mirror_rows[0][0], np.int32)
+        hist = [(0, 0)] * fence
+        for j in range(n_steps):
+            hist.append((int(rows0[a0[j], det.LANE_P + 1]),
+                         int(rows0[a0[j] + 1, det.LANE_P])))
+        runner.executor.step_input_history = hist
+        runner.executor.epoch_id = from_epoch + k
+        runner.executor.step_in_epoch = 0
+        for j in range(k + 1):
+            runner._fence_step[from_epoch + j] = fence + j * spe
+        runner._ring_tail_mirror = fence
+        runner._ck_log_heads[ckpt.checkpoint_id] = np.asarray(
+            ckpt.carry.log_heads).astype(np.int64)
+
+        # Roll-gap / async ledgers, re-derived from the mirrored streams:
+        # rows between one epoch's last sync block and the next epoch's
+        # first anchor are that next epoch's roll-gap appends (exact when
+        # between-epoch appends happen only at rolls — fence
+        # SOURCE_CHECKPOINTs, ignore broadcasts; see executor.roll_gap_async).
+        for flat, (rows, _start) in mirror_rows.items():
+            rows = np.asarray(rows, np.int32)
+            a = anchors_by_flat[flat]
+            for j in range(k + 1):
+                if j == 0:
+                    gap = int(a[0]) if len(a) else rows.shape[0]
+                else:
+                    prev_end = int(a[j * spe - 1]) + DETS_PER_STEP
+                    nxt = (int(a[j * spe]) if j < k else rows.shape[0])
+                    gap = nxt - prev_end
+                if gap > 0:
+                    runner.executor.roll_gap_async[
+                        (flat, from_epoch + j)] = gap
+            # async totals per epoch (cleanness ledger for FUTURE
+            # failures of the rebuilt cluster).
+            for j in range(k):
+                lo = int(a[j * spe])
+                hi = int(a[(j + 1) * spe]) if j + 1 < k else rows.shape[0]
+                async_n = (hi - lo) - spe * DETS_PER_STEP
+                lead_gap = runner.executor.roll_gap_async.get(
+                    (flat, from_epoch + j), 0)
+                total_async = async_n + (lead_gap if j == 0 else 0)
+                if total_async > 0:
+                    runner.executor.async_counts[
+                        (flat, from_epoch + j)] = total_async
+
+        # In-flight ring offsets/epoch index as the dead worker had them:
+        # content is rebuilt by the per-vertex ring write-backs during
+        # recover(); offsets must already read (tail=fence, head=fence+n)
+        # for the topological routing to see its coverage.
+        c = runner.executor.carry
+        new_rings = []
+        for el in c.out_rings:
+            starts = np.asarray(el.epoch_starts)
+            me = starts.shape[0]
+            starts = starts.copy()
+            for j in range(k + 1):
+                starts[(from_epoch + j) % me] = fence + j * spe
+            new_rings.append(el._replace(
+                head=jnp.asarray(fence + n_steps, jnp.int32),
+                tail=jnp.asarray(fence, jnp.int32),
+                epoch_starts=jnp.asarray(starts, jnp.int32),
+                latest_epoch=jnp.asarray(from_epoch + k, jnp.int32),
+                epoch_base=jnp.asarray(from_epoch, jnp.int32)))
+        runner.executor.carry = c._replace(out_rings=tuple(new_rings))
+
+        # Everything is failed; recover() rebuilds it all from the
+        # checkpoint + mirror rows, in topological order.
+        runner.failed = set(range(L))
+        for f in range(L):
+            runner.heartbeats.mark_dead(f)
+        report = runner.recover(host_rows=mirror_rows)
+
+        # The depth-1 edge buffers (the in-flight batch produced at step
+        # fence+n-1, consumed by the NEXT live step) are not part of
+        # replay's input range — route that one step from the rebuilt
+        # rings now.
+        if n_steps > 0:
+            c = runner.executor.carry
+            ch = runner._chunk()
+            bufs = list(c.edge_bufs)
+            for eidx, e in enumerate(job.edges):
+                ri = runner.executor.compiled.ring_index[e.src]
+                z = jnp.asarray(0, jnp.int32)
+                routed, *_ = runner._route_chunk_fn(
+                    eidx, ch, all_lanes=True)(
+                    c.out_rings[ri],
+                    jnp.asarray(fence + n_steps - 1, jnp.int32),
+                    z, jnp.asarray(1, jnp.int32), z)
+                bufs[eidx] = jax.tree_util.tree_map(
+                    lambda x: x[0], routed)
+            runner.executor.carry = c._replace(edge_bufs=tuple(bufs))
+        return runner, report
+
+    def state_digest(self) -> str:
+        """Canonical digest of the recoverable job state: operator
+        states, record counts, log heads and each log's live row window.
+        A standby-host rebuild (bootstrap_standby) must reproduce the
+        dead worker's digest at its last mirrored fence EXACTLY — the
+        cross-process bit-identity check (reference: state handle
+        equality on restore)."""
+        import hashlib
+        h = hashlib.sha1()
+        for vid in range(len(self.job.vertices)):
+            st = self.executor.vertex_state(vid)
+            for k in sorted(st):
+                h.update(np.asarray(st[k]).tobytes())
+        c = self.executor.carry
+        heads = np.asarray(c.logs.head)
+        tails = np.asarray(c.logs.tail)
+        rows = np.asarray(c.logs.rows)
+        cap = rows.shape[1]
+        h.update(heads.tobytes())
+        for flat in range(rows.shape[0]):
+            pos = np.arange(int(tails[flat]), int(heads[flat])) & (cap - 1)
+            h.update(rows[flat][pos].tobytes())
+        h.update(np.asarray(c.record_counts).tobytes())
+        return h.hexdigest()
+
     # --- steady state --------------------------------------------------------
 
     def run_epoch(self, complete_checkpoint: bool = True) -> None:
@@ -641,6 +852,12 @@ class ClusterRunner:
         # the new epoch) — recovery's patch phase reads them from here
         # instead of paying a device round-trip on the failure path.
         self._ck_log_heads[closed] = vec[nf + 1:].astype(np.int64)
+        # Bounded even when checkpoints never complete (the completion
+        # hook prunes harder): a pruned-but-needed entry only costs the
+        # patch fallback's one device read.
+        if len(self._ck_log_heads) > 128:
+            for k in sorted(self._ck_log_heads)[:-128]:
+                del self._ck_log_heads[k]
         delta_records = total_records - self._last_records_total
         self._m_records.mark(delta_records)
         self._last_records_total = total_records
@@ -758,7 +975,9 @@ class ClusterRunner:
     def detect_failures(self) -> List[int]:
         return self.heartbeats.expired()
 
-    def recover(self, drill: bool = False) -> RecoveryReport:
+    def recover(self, drill: bool = False,
+                host_rows: Optional[Dict[int, Tuple[np.ndarray, int]]]
+                = None) -> RecoveryReport:
         """Run the full causal-recovery protocol for all failed subtasks,
         in topological order (an upstream's reconstructed ring shard feeds
         its downstream's replay — the reference's staged
@@ -769,7 +988,14 @@ class ClusterRunner:
         pending checkpoints are not ignored (they may yet complete),
         no IGNORE_CHECKPOINT determinants are logged, the checkpoint
         interval is not backed off, and recovered timer effects are not
-        re-fired — so the job state is bit-identical afterwards."""
+        re-fired — so the job state is bit-identical afterwards.
+
+        ``host_rows`` maps flat subtask -> (rows, abs_start): an external
+        determinant source that replaces the on-device replica fetch for
+        those subtasks — the standby-HOST path, where the rows come from
+        a RemoteReplicaMirror after a whole-host loss (reference
+        DeterminantResponseEvent arriving over the wire instead of the
+        local piggyback channel)."""
         if not self.failed:
             raise rec.RecoveryError("no failed subtasks")
         if not self.standbys.has_state():
@@ -858,6 +1084,11 @@ class ClusterRunner:
         for flat in failed:
             vid_a, _sub_a = self._vertex_of(flat)
             v_a = self.job.vertices[vid_a]
+            if host_rows is not None and flat in host_rows:
+                # External determinant source (standby-host mirror):
+                # no device fetch/parse to dispatch at all.
+                prep[flat] = {"holders": [], "fast": False, "host": True}
+                continue
             holders_a = [
                 (r, h) for r, (o, h) in enumerate(self.plan.pairs)
                 if o == flat and h not in self.failed]
@@ -948,7 +1179,15 @@ class ClusterRunner:
             holders = p["holders"]
             fast = p["fast"]
             synthesized = False
-            if not holders and n_steps > 0:
+            if p.get("host"):
+                # Mirror-sourced determinants (whole-host loss): the rows
+                # arrived over the wire; everything downstream of the
+                # fetch (merge, replay, verify, patch) is identical.
+                rows_h, start_h = host_rows[flat]
+                mgr.expect_determinant_responses(1)
+                mgr.notify_determinant_response(
+                    np.asarray(rows_h, np.int32), int(start_h))
+            elif not holders and n_steps > 0:
                 if out_edges:
                     raise rec.RecoveryError(
                         f"subtask {flat}: no surviving replica holds its "
@@ -964,7 +1203,9 @@ class ClusterRunner:
             r_best = None
             det_device = None
             clean_n = None
-            if fast:
+            if p.get("host"):
+                pass          # responses already delivered above
+            elif fast:
                 # Host-derived cleanness: zero async rows since the fence
                 # means the log holds exactly n_steps k-row sync blocks
                 # starting at the checkpointed head. Everything the old
@@ -1305,7 +1546,15 @@ class ClusterRunner:
 
         vids = (list(vertex_ids) if vertex_ids is not None
                 else [v.vertex_id for v in self.job.vertices])
-        for vid in vids:
+        # Independent compiles run CONCURRENTLY: each job below first-calls
+        # one jit program; XLA compilations of distinct programs proceed in
+        # parallel across threads (the executions they also trigger are
+        # tiny and serialize on the device queue). This roughly divides
+        # prewarm wall-clock by min(#workers, #independent programs).
+        jobs: List[Any] = []
+        heavy: List[Tuple[int, Any]] = []
+
+        def _edge_jobs(vid: int) -> None:
             v = self.job.vertices[vid]
             in_edges = self.job.in_edges(vid)
             # Ring/route/concat programs for each input edge.
@@ -1320,23 +1569,42 @@ class ClusterRunner:
                 # old first-chunk ch-1 variants doubled these compiles).
                 # Both routing variants: fused lane (single failure) and
                 # all-lane + select (connected-failure sharing).
-                self._route_chunk_fn(eidx, ch)(el, z, z, z, z, z)
-                routed, *_ = self._route_chunk_fn(
-                    eidx, ch, all_lanes=True)(el, z, z, z, z)
-                self._lane_select_fn(eidx, ch)(routed, z)
+                jobs.append(lambda eidx=eidx, el=el, z=z:
+                            self._route_chunk_fn(eidx, ch)(
+                                el, z, z, z, z, z))
+
+                def _all_lane(eidx=eidx, el=el, z=z):
+                    routed, *_ = self._route_chunk_fn(
+                        eidx, ch, all_lanes=True)(el, z, z, z, z)
+                    self._lane_select_fn(eidx, ch)(routed, z)
+                jobs.append(_all_lane)
                 if spill_paths:
                     # Spill-path twin (AVAILABILITY wrap recovery):
                     # doubles the exchange compiles, so opt-in — a
                     # ring-covered recovery (the common case) never
                     # takes this path.
-                    self._ring_chunk_fn(ri, ch)(el, z)
-                    self._route_raw_fn(eidx, ch)(
-                        zero_batch((ch, src_p, src_cap)), z, z, z, z, z)
-                    self._route_raw_fn(eidx, ch, all_lanes=True)(
-                        zero_batch((ch, src_p, src_cap)), z, z, z, z)
-                self._first_chunk_fn(eidx)(
-                    zero_batch((1, e.capacity)),
-                    zero_batch((ch, e.capacity)))
+                    jobs.append(lambda ri=ri, el=el, z=z:
+                                self._ring_chunk_fn(ri, ch)(el, z))
+                    jobs.append(lambda eidx=eidx, src_p=src_p,
+                                src_cap=src_cap, z=z:
+                                self._route_raw_fn(eidx, ch)(
+                                    zero_batch((ch, src_p, src_cap)),
+                                    z, z, z, z, z))
+                    jobs.append(lambda eidx=eidx, src_p=src_p,
+                                src_cap=src_cap, z=z:
+                                self._route_raw_fn(
+                                    eidx, ch, all_lanes=True)(
+                                    zero_batch((ch, src_p, src_cap)),
+                                    z, z, z, z))
+                jobs.append(lambda eidx=eidx, e=e:
+                            self._first_chunk_fn(eidx)(
+                                zero_batch((1, e.capacity)),
+                                zero_batch((ch, e.capacity))))
+
+        def _vertex_jobs(vid: int) -> None:
+            v = self.job.vertices[vid]
+            in_edges = self.job.in_edges(vid)
+            _edge_jobs(vid)
             # Replay block program(s).
             slot_keys = compiled.consumer_slot_keys(vid)
             subs = range(v.parallelism) if slot_keys is not None else [0]
@@ -1349,7 +1617,8 @@ class ClusterRunner:
                 chunk0 = (zero_batch((ch, in_cap)), zero_batch((ch, cap2)))
             else:
                 chunk0 = zero_batch((ch, in_cap))
-            for sub in subs:
+
+            def _replay_job(sub, state0=state0, chunk0=chunk0):
                 rp = self._make_replayer(vid, sub)
                 rp._jit_block(state0, chunk0, zero((ch,)), zero((ch,)),
                               jnp.asarray(sub, jnp.int32),
@@ -1358,26 +1627,48 @@ class ClusterRunner:
                 # every failure uses; see LogReplayer.pad_steps).
                 rp._jit_tslice(zero((rp.pad_steps or ch,)),
                                jnp.asarray(0, jnp.int32))
-            # Graft + kill + ring write (donated arg 0: disposable
-            # dummies, never the live carry).
-            dummy = jax.tree_util.tree_map(lambda x: jnp.zeros_like(x),
-                                           carry)
-            dummy = self._graft_fn(vid)(
-                dummy, state0, st, jnp.asarray(0, jnp.int32),
-                jnp.asarray(0, jnp.int32), jnp.asarray(0, jnp.int32))
+            for sub in subs:
+                jobs.append(lambda sub=sub: _replay_job(sub))
+
+            heavy.append((vid, state0))
+
+        for vid in vids:
+            _vertex_jobs(vid)
+
+        def _heavy_chain():
+            # Donated-dummy programs (graft / kill / ring write) allocate
+            # carry-scale buffers — running them concurrently multiplies
+            # GB-scale dummies and OOMs the chip. ONE dummy carry is
+            # threaded serially through every vertex's programs instead
+            # (donation recycles it), bounding peak memory to a single
+            # extra carry.
+            dummy = jax.tree_util.tree_map(
+                lambda x: jnp.zeros_like(x), carry)
             nrp = max(compiled.plan.num_replicas, 1)
-            self._inject_fn(vid)(
-                dummy, jnp.asarray(0, jnp.int32), jnp.asarray(0, jnp.int32),
-                jnp.full((nrp,), nrp, jnp.int32))
-            if vid in compiled.ring_index:
+            for vid, state0 in heavy:
+                dummy = self._graft_fn(vid)(
+                    dummy, state0, st, jnp.asarray(0, jnp.int32),
+                    jnp.asarray(0, jnp.int32), jnp.asarray(0, jnp.int32))
+                dummy = self._inject_fn(vid)(
+                    dummy, jnp.asarray(0, jnp.int32),
+                    jnp.asarray(0, jnp.int32),
+                    jnp.full((nrp,), nrp, jnp.int32))
+            rings = list(dummy.out_rings)
+            for vid, _ in heavy:
+                if vid not in compiled.ring_index:
+                    continue
                 ri = compiled.ring_index[vid]
                 out_cap = compiled.vertex_out_capacity(vid)
                 z = jnp.asarray(0, jnp.int32)
-                self._ring_write_fn(ri, ch)(
-                    jax.tree_util.tree_map(lambda x: jnp.zeros_like(x),
-                                           carry.out_rings[ri]),
-                    zero_batch((ch, out_cap)),
+                rings[ri], _b = self._ring_write_fn(ri, ch)(
+                    rings[ri], zero_batch((ch, out_cap)),
                     z, z, jnp.asarray(1, jnp.int32), z)
+        jobs.append(_heavy_chain)
+
+        from concurrent.futures import ThreadPoolExecutor
+        with ThreadPoolExecutor(max_workers=4) as pool:
+            for res in pool.map(lambda j: j(), jobs):
+                pass
         return _time.monotonic() - t0
 
     def failover_drill(self, flats: Optional[Sequence[int]] = None

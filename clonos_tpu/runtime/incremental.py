@@ -323,10 +323,11 @@ class IncrementalCheckpointStorage(CheckpointStorage):
                 needed.update(self._chain(cid))
         removed = False
         for cid in [z for z in self._zombie if z not in needed]:
-            try:
-                os.remove(self._path(cid))
-            except OSError:
-                pass
+            for p in (self._path(cid), self._path(cid) + ".done"):
+                try:
+                    os.remove(p)
+                except OSError:
+                    pass
             self._zombie.discard(cid)
             self._index.pop(cid, None)
             if cid in self._order:

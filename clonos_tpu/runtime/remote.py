@@ -89,10 +89,12 @@ class TaskExecutorClient:
     """Executor-side stub: register once, heartbeat on a thread."""
 
     def __init__(self, executor_id: str, jm_address: Tuple[str, int],
-                 interval_s: float = 1.0):
+                 interval_s: float = 1.0,
+                 info: Optional[dict] = None):
         self.executor_id = executor_id
         self._client = tp.ControlClient(tuple(jm_address))
-        self._client.call_json(tp.REGISTER, {"executor_id": executor_id})
+        self._client.call_json(tp.REGISTER, {"executor_id": executor_id,
+                                             **(info or {})})
         self._interval = interval_s
         #: consecutive heartbeat RPC failures (0 when healthy)
         self.missed_beats = 0
@@ -191,6 +193,87 @@ class HostLogEndpoint:
         self.server.close()
 
 
+class JobMasterController:
+    """Drives standby-HOST failover around :class:`JobMasterServer` — the
+    piece that turns the control-plane endpoints into a running recovery
+    loop (reference JobMaster.java:151 failover driving +
+    RunStandbyTaskStrategy dispatch):
+
+    - every registered worker that advertises a log endpoint gets a
+      :class:`RemoteReplicaMirror` (the standby host's copy of its
+      determinant logs), pulled by :meth:`sync`;
+    - :meth:`failed` surfaces heartbeat-expired workers;
+    - :meth:`rebuild` reconstructs a dead worker's ENTIRE job in this
+      process via ``ClusterRunner.bootstrap_standby`` — durable
+      checkpoint + mirror rows — CONSUMING the ignore-checkpoint ledger
+      workers reported (an ignored checkpoint must never be a restore
+      point).
+
+    Mirror peer assignment in multi-worker deployments follows the same
+    rotate-by-one placement rule as
+    ``parallel.distributed.standby_device_order`` — a host never mirrors
+    itself, so a host loss cannot take a log and its mirror together."""
+
+    def __init__(self, jm: JobMasterServer,
+                 mirror_capacity: int = 1 << 14, max_epochs: int = 64):
+        self.jm = jm
+        self.mirror_capacity = mirror_capacity
+        self.max_epochs = max_epochs
+        self.mirrors: Dict[str, RemoteReplicaMirror] = {}
+
+    def attach(self) -> List[str]:
+        """Create mirrors for newly-registered workers (idempotent)."""
+        new = []
+        with self.jm._lock:
+            meta = dict(self.jm._meta)
+        for eid, info in meta.items():
+            if eid in self.mirrors or "log_port" not in info:
+                continue
+            self.mirrors[eid] = RemoteReplicaMirror(
+                (info.get("log_host", "127.0.0.1"), info["log_port"]),
+                flats=list(range(info["num_subtasks"])),
+                capacity=self.mirror_capacity, max_epochs=self.max_epochs)
+            new.append(eid)
+        return sorted(new)
+
+    def sync(self) -> Dict[str, int]:
+        """One pull round over every healthy worker's mirror."""
+        out = {}
+        dead = set(self.jm.expired())
+        for eid, m in self.mirrors.items():
+            if eid in dead:
+                continue
+            try:
+                out[eid] = m.sync()
+            except OSError:
+                out[eid] = -1          # endpoint gone; heartbeats decide
+        return out
+
+    def failed(self) -> List[str]:
+        return self.jm.expired()
+
+    def ignored_checkpoints(self) -> List[int]:
+        with self.jm._lock:
+            return sorted(set(self.jm._ignored))
+
+    def rebuild(self, executor_id: str, job, **runner_kw):
+        """Standby-host failover for ``executor_id``'s job: bootstrap a
+        fresh runner in THIS process from the worker's durable
+        checkpoint dir + this controller's mirror of its logs."""
+        from clonos_tpu.runtime.cluster import ClusterRunner
+        with self.jm._lock:
+            info = dict(self.jm._meta[executor_id])
+        mirror = self.mirrors[executor_id]
+        rows = {f: mirror.rows_with_start(f) for f in mirror.flats}
+        return ClusterRunner.bootstrap_standby(
+            job, info["checkpoint_dir"], rows,
+            ignored_checkpoints=self.ignored_checkpoints(), **runner_kw)
+
+    def close(self) -> None:
+        for m in self.mirrors.values():
+            m.close()
+
+
 class RemoteReplicaMirror:
     """Standby-host replica of remote task logs: host-side
     :class:`clog.ThreadCausalLog` wrappers merged with the on-chip
@@ -212,6 +295,12 @@ class RemoteReplicaMirror:
     def rows(self, flat: int) -> np.ndarray:
         log = self._replicas[flat]
         return log.delta_for_consumer(log.tail, log.head - log.tail)[0]
+
+    def rows_with_start(self, flat: int) -> Tuple[np.ndarray, int]:
+        """(live rows, absolute offset of rows[0]) — the determinant-
+        source form ClusterRunner.bootstrap_standby consumes."""
+        log = self._replicas[flat]
+        return (self.rows(flat), int(log.tail))
 
     def sync(self) -> int:
         """One pull round: request each owned log's suffix past our head,

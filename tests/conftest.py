@@ -20,3 +20,10 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402  (import after XLA_FLAGS is set)
 
 jax.config.update("jax_platforms", "cpu")
+
+# Persistent compile cache: the suite is compile-dominated; a warm cache
+# cuts repeat runs several-fold. Keyed by HLO hash — safe across edits.
+from clonos_tpu.utils.compile_cache import enable_compile_cache  # noqa: E402
+
+enable_compile_cache(os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), ".jax_cache"))

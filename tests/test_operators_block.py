@@ -116,3 +116,36 @@ def test_two_input_union_block_equals_scan():
         op, s, b, c))((), (left, right), bctx)
     blk = jax.jit(op.process_block)((), (left, right), bctx)
     _assert_equal(ref[1], blk[1])
+
+
+def test_interval_join_grouped_block_equals_scan():
+    """The grouped join block (G steps fused per scan iteration) must be
+    bit-identical to the sequential per-step semantics — state (ring
+    contents, cursors) AND per-step output batches, including intra-step
+    overflow drops, ring-slot emission order and cross-group windows."""
+    from clonos_tpu.api.operators import TwoInputOperator
+    for seed, cap, w, kk in ((0, 16, 4, 8), (1, 4, 2, 8), (2, 8, 1, 6),
+                             (3, 64, 3, 12)):
+        op = IntervalJoinOperator(num_keys=NK, window=w, interval=20,
+                                  capacity=cap)
+        rng = np.random.RandomState(seed)
+
+        def mk(b):
+            return zero_invalid(RecordBatch(
+                jnp.asarray(rng.randint(0, NK, (kk, P, b)), jnp.int32),
+                jnp.asarray(rng.randint(1, 5, (kk, P, b)), jnp.int32),
+                jnp.asarray(rng.randint(0, 60, (kk, P, b)), jnp.int32),
+                jnp.asarray(rng.rand(kk, P, b) < 0.6)))
+        left, right = mk(B), mk(B)
+        state = op.init_state(P)
+        t = jnp.asarray(np.arange(kk) * 3, jnp.int32)
+        bctx = BlockContext(
+            times=t, rng_bits=t + 100, epoch=jnp.zeros((), jnp.int32),
+            step0=jnp.zeros((), jnp.int32),
+            subtask=jnp.arange(P, dtype=jnp.int32))
+        ref = jax.jit(lambda s, l, r, c: TwoInputOperator.process_block(
+            op, s, (l, r), c))(state, left, right, bctx)
+        blk = jax.jit(lambda s, l, r, c: op.process_block(
+            s, (l, r), c))(state, left, right, bctx)
+        _assert_equal(ref[0], blk[0])
+        _assert_equal(ref[1], blk[1])
