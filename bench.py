@@ -227,11 +227,17 @@ def sharing_depth_sweep():
             .sink())
         job = env.build()
         job.sharing_depth = depth
-        plan = ReplicationPlan.from_job(job, depth)
+        # replication_factor=1: ONE holder per owner per depth level, so
+        # "survives k connected failures" maps exactly to the depth knob
+        # (with full bipartite replication every depth-1 owner has P
+        # holders and even owner+holder failures survive — that measures
+        # the factor, not the depth).
+        plan = ReplicationPlan.from_job(job, depth, replication_factor=1)
         cap = 1 << (SPE * 4 * 2 - 1).bit_length()
         runner = ClusterRunner(job, steps_per_epoch=SPE, log_capacity=cap,
                                max_epochs=16, inflight_ring_steps=1 << 10,
-                               block_steps=512, seed=7)
+                               block_steps=512, replication_factor=1,
+                               seed=7)
         runner.run_epoch(complete_checkpoint=True)
         device_sync(runner.executor.carry)
         t_w = time.monotonic()
@@ -240,6 +246,7 @@ def sharing_depth_sweep():
         live_s = time.monotonic() - t_w
         entry = {
             "depth": depth,
+            "replication_factor": 1,
             "replica_logs": plan.num_replicas,
             "replica_bytes": plan.num_replicas * cap * 8 * 4,
             "survives_connected_failures": (
@@ -291,6 +298,7 @@ def main():
                            inflight_ring_steps=1 << (span - 1).bit_length(),
                            recovery_block_steps=8192,
                            block_steps=1024,
+                           latency_marker_every=64,
                            seed=7)
 
     t_warm0 = time.monotonic()
@@ -404,6 +412,13 @@ def main():
         "steady_state_records_per_sec": round(throughput, 1),
         "subtasks": job.total_subtasks(),
         "device": str(jax.devices()[0].platform),
+        # Latency markers (causal-RNG scheduled, replay-stable): pipeline
+        # transit time source->sink in causal-time ms.
+        "latency_markers": {
+            "count": runner.latency.hist.count,
+            "p50_ms": runner.latency.hist.quantile(0.5),
+            "p99_ms": runner.latency.hist.quantile(0.99),
+        },
     }
     # Free the headline runner's device state BEFORE the secondary
     # configs build theirs — two multi-GB carries do not coexist on one

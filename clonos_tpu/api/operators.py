@@ -114,6 +114,39 @@ class Operator:
         (routing.StaticRoutePlan) — no sort, no scatter."""
         return None
 
+    def rescale_keyed_state(self, state: Any, new_parallelism: int,
+                            num_key_groups: int) -> Any:
+        """Remap checkpointed state to a DIFFERENT parallelism by key
+        ownership (reference StateAssignmentOperation +
+        KeyGroupRangeAssignment: state is split/merged along key-group
+        ranges). Dense-table operators implement it as sum-then-remask:
+        per-key rows are disjoint across old subtasks (each only ever saw
+        its own keys), so the global table is the subtask sum and each
+        new subtask keeps the keys the new assignment routes to it.
+        Operators without a keyed rescaling story raise."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support rescaling")
+
+
+def rescale_dense_table(table: jnp.ndarray, new_parallelism: int,
+                        num_key_groups: int,
+                        fill: int = 0) -> jnp.ndarray:
+    """Remap a dense keyed table ``[P, ..., K]`` to ``new_parallelism``:
+    sum over the old subtask axis (rows are disjoint by key ownership)
+    and keep, per new subtask, only the keys the new key-group
+    assignment routes to it (``fill`` elsewhere — the operator's init
+    value, what an untouched key holds)."""
+    from clonos_tpu.parallel.routing import (key_group,
+                                             subtask_for_key_group)
+    nk = table.shape[-1]
+    total = (table - fill).sum(axis=0) + fill
+    kg = key_group(jnp.arange(nk, dtype=jnp.int32), num_key_groups)
+    owner = subtask_for_key_group(kg, new_parallelism, num_key_groups)
+    sub = jnp.arange(new_parallelism, dtype=jnp.int32)
+    mask = (owner[None, :] == sub[:, None]).reshape(
+        (new_parallelism,) + (1,) * (total.ndim - 1) + (nk,))
+    return jnp.where(mask, total[None], fill)
+
 
 class TwoInputOperator(Operator):
     """Base for vertices with two input edges (ConnectedStreams /
@@ -262,6 +295,11 @@ class KeyedReduceOperator(Operator):
     def init_state(self, parallelism: int):
         return {"acc": jnp.full((parallelism, self.num_keys), self.init_value,
                                 jnp.int32)}
+
+    def rescale_keyed_state(self, state, new_parallelism, num_key_groups):
+        return {"acc": rescale_dense_table(
+            state["acc"], new_parallelism, num_key_groups,
+            fill=self.init_value)}
 
     def process(self, state, batch, ctx):
         def one(acc, b: RecordBatch):
@@ -442,6 +480,14 @@ class TumblingWindowCountOperator(Operator):
             valid=fire[:, :, None] & (emit != 0)))
         return ({"acc": acc_end[-1],
                  "window": jnp.maximum(w0, rm[-1])}, out)
+
+    def rescale_keyed_state(self, state, new_parallelism, num_key_groups):
+        # Window ids are lockstep across subtasks (driven by shared
+        # causal time): carry the max forward.
+        return {"acc": rescale_dense_table(state["acc"], new_parallelism,
+                                           num_key_groups),
+                "window": jnp.broadcast_to(state["window"].max(),
+                                           (new_parallelism,))}
 
     def static_out_keys(self) -> Optional[np.ndarray]:
         # Dense table emission: slot i always carries key i.

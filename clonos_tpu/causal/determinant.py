@@ -302,6 +302,17 @@ _TAG_TO_CLASS: Dict[int, Type[Determinant]] = {
 # --- batch codec (reference SimpleDeterminantEncoder.java:35 equivalent) ----
 
 
+def sync_anchors(rows: np.ndarray) -> np.ndarray:
+    """Indices of per-step sync-block anchors in a packed row stream:
+    TIMESTAMP rows with a ZERO record-count stamp. Async appends stamp a
+    nonzero count precisely so they can't masquerade as step anchors
+    (executor.append_async_determinant) — every consumer of the stream
+    layout shares this one predicate."""
+    rows = np.asarray(rows)
+    return np.where((rows[:, LANE_TAG] == TIMESTAMP)
+                    & (rows[:, LANE_RC] == 0))[0]
+
+
 def pack_batch(dets: Sequence[Determinant]) -> np.ndarray:
     """Pack determinants into an ``int32[n, NUM_LANES]`` array."""
     if not dets:

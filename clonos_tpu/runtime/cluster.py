@@ -105,6 +105,52 @@ class StandbyPool:
         return self.latest is not None
 
 
+class LatencyMarkers:
+    """Latency markers, TPU-first (reference RecordWriter.randomEmit
+    routing markers through RandomService so replay reproduces them,
+    RecordWriter.java:131-137 + LatencyMarker):
+
+    Marker STEPS are chosen by the per-step causal RNG draw
+    (``rng % every == 0``). Those draws are recorded determinants, so a
+    recovered task re-derives the SAME marker schedule — replay-stable
+    by construction. A record emitted at source step ``s`` reaches the
+    sink at step ``s + depth`` (the depth-1 superstep pipeline), so the
+    marker's latency is the causal-time delta between those two steps'
+    inputs — pipeline transit time as experienced by the data, reacting
+    to stalls exactly like the reference's markers. Feeds the
+    ``latency-ms`` registry histogram."""
+
+    def __init__(self, runner: "ClusterRunner", every: int):
+        self.runner = runner
+        self.every = every
+        job = runner.job
+        # Pipeline depth: longest source->sink path in edges.
+        depth = {v.vertex_id: 0 for v in job.vertices}
+        for vid in job.topo_order():
+            for e in job.in_edges(vid):
+                depth[vid] = max(depth[vid],
+                                 depth[job.edges[e].src] + 1)
+        self.depth = max(depth.values()) if depth else 0
+        self.hist = runner.metrics.group(
+            f"job.{job.name}").histogram("latency-ms")
+        self._seen = 0
+
+    @staticmethod
+    def schedule(rngs, every: int):
+        """Marker steps for a given rng-draw stream (pure — recovery
+        tests re-derive it from recovered determinant rows)."""
+        return [i for i, r in enumerate(rngs) if r % every == 0]
+
+    def observe(self) -> None:
+        hist = self.runner.executor.step_input_history
+        upto = len(hist) - self.depth
+        for s in range(self._seen, max(upto, 0)):
+            t, r = hist[s]
+            if r % self.every == 0:
+                self.hist.update(hist[s + self.depth][0] - t)
+        self._seen = max(self._seen, upto, 0)
+
+
 @dataclasses.dataclass
 class RecoveryReport:
     """What one failure's recovery did (metrics + test surface)."""
@@ -144,6 +190,7 @@ class ClusterRunner:
                  incremental_base_every: int = 8,
                  prewarm: bool = False,
                  recovery_block_steps: Optional[int] = None,
+                 latency_marker_every: Optional[int] = None,
                  **executor_kw):
         self.job = job
         self.executor = LocalExecutor(job, steps_per_epoch=steps_per_epoch,
@@ -239,6 +286,10 @@ class ClusterRunner:
         #: (reference SystemProcessingTimeService.java:50,79-114).
         self.timer_services: Dict[int, Any] = {}
         self.executor.block_listeners.append(self._advance_timers)
+        #: latency markers through the causal RNG path (RecordWriter
+        #: .randomEmit analog); None = off.
+        self.latency = (LatencyMarkers(self, latency_marker_every)
+                        if latency_marker_every else None)
         #: source subtasks (no input edges): their logs record
         #: SOURCE_CHECKPOINT determinants at every trigger
         #: (StreamTask.performCheckpoint:833-840).
@@ -675,12 +726,9 @@ class ClusterRunner:
         # Steps replayed = sync-anchor count of the mirrored streams
         # (lockstep supersteps: every log advances together, and the
         # mirror snapshot is prefix-consistent across flats).
-        anchors_by_flat: Dict[int, np.ndarray] = {}
-        for flat, (rows, _start) in mirror_rows.items():
-            rows = np.asarray(rows, np.int32)
-            anchors_by_flat[flat] = np.where(
-                (rows[:, det.LANE_TAG] == det.TIMESTAMP)
-                & (rows[:, det.LANE_RC] == 0))[0]
+        anchors_by_flat: Dict[int, np.ndarray] = {
+            flat: det.sync_anchors(rows)
+            for flat, (rows, _start) in mirror_rows.items()}
         ns = {len(a) for a in anchors_by_flat.values()}
         if len(ns) != 1:
             raise rec.RecoveryError(
@@ -708,6 +756,11 @@ class ClusterRunner:
             hist.append((int(rows0[a0[j], det.LANE_P + 1]),
                          int(rows0[a0[j] + 1, det.LANE_P])))
         runner.executor.step_input_history = hist
+        if runner.latency is not None:
+            # Placeholder entries (rng=0) would all read as markers and
+            # flood the histogram with zero samples — markers resume at
+            # the first post-rebuild step.
+            runner.latency._seen = len(hist)
         runner.executor.epoch_id = from_epoch + k
         runner.executor.step_in_epoch = 0
         for j in range(k + 1):
@@ -795,6 +848,87 @@ class ClusterRunner:
             runner.executor.carry = c._replace(edge_bufs=tuple(bufs))
         return runner, report
 
+    @classmethod
+    def restore_rescaled(cls, job_new: JobGraph, job_old: JobGraph,
+                         ckpt: cp.CompletedCheckpoint,
+                         **runner_kw) -> "ClusterRunner":
+        """Restore a completed checkpoint into a job whose keyed vertices
+        run at a DIFFERENT parallelism (the planned-rescale restart;
+        reference CheckpointCoordinator.restoreSavepoint ->
+        StateAssignmentOperation with KeyGroupRangeAssignment). Dense
+        keyed state splits/merges by key-group ownership
+        (Operator.rescale_keyed_state); checkpointed depth-1 edge
+        buffers re-route through the hash exchange at the new
+        parallelism. The restored incarnation starts a fresh causal-log
+        epoch 0 — a rescale is a planned restart at a completed fence,
+        so there is nothing to replay.
+
+        Constraints: topology (vertex count, operator types, edge
+        partition kinds) must match; rescaled vertices' input edges must
+        be HASH (key ownership defines the split); vertices without a
+        keyed rescaling story must keep their parallelism."""
+        if len(job_new.vertices) != len(job_old.vertices) or \
+                len(job_new.edges) != len(job_old.edges):
+            raise rec.RecoveryError(
+                "restore_rescaled: topology mismatch between jobs")
+        runner = cls(job_new, **runner_kw)
+        cpy = lambda t: jax.tree_util.tree_map(
+            lambda x: jnp.asarray(x).copy(), t)
+        snap = ckpt.carry
+        carry = runner.executor.carry
+        ops = list(carry.op_states)
+        for v_new, v_old in zip(job_new.vertices, job_old.vertices):
+            if type(v_new.operator) is not type(v_old.operator):
+                raise rec.RecoveryError(
+                    f"restore_rescaled: vertex {v_new.vertex_id} operator "
+                    f"type changed")
+            vid = v_new.vertex_id
+            st = cpy(snap.op_states[vid])
+            if v_new.parallelism == v_old.parallelism:
+                ops[vid] = st
+            else:
+                for eidx in job_new.in_edges(vid):
+                    if job_new.edges[eidx].partition != PartitionType.HASH:
+                        raise rec.RecoveryError(
+                            f"restore_rescaled: vertex {vid} rescaled but "
+                            f"input edge {eidx} is not HASH-partitioned")
+                ops[vid] = v_new.operator.rescale_keyed_state(
+                    st, v_new.parallelism, job_new.num_key_groups)
+        bufs = list(carry.edge_bufs)
+        for eidx, (e_new, e_old) in enumerate(zip(job_new.edges,
+                                                  job_old.edges)):
+            if e_new.partition != e_old.partition:
+                raise rec.RecoveryError(
+                    f"restore_rescaled: edge {eidx} partition changed")
+            old_buf = cpy(snap.edge_bufs[eidx])
+            dst_p = job_new.vertices[e_new.dst].parallelism
+            if e_new.partition == PartitionType.HASH:
+                raw = jax.tree_util.tree_map(lambda x: x[None], old_buf)
+                routed, dropped = routing.route_hash_block(
+                    raw, dst_p, job_new.num_key_groups, e_new.capacity)
+                # Rescaling DOWN concentrates old lanes' records; an
+                # overflow here would silently lose in-flight records
+                # and break the identical-output contract — fail loud.
+                if int(np.asarray(dropped).sum()) > 0:
+                    raise rec.RecoveryError(
+                        f"restore_rescaled: edge {eidx} buffer overflows "
+                        f"capacity {e_new.capacity} at parallelism "
+                        f"{dst_p} — widen the edge capacity of the "
+                        f"rescaled job")
+                bufs[eidx] = jax.tree_util.tree_map(
+                    lambda x: x[0], routed)
+            else:
+                want = bufs[eidx].keys.shape
+                if old_buf.keys.shape != want:
+                    raise rec.RecoveryError(
+                        f"restore_rescaled: edge {eidx} buffer shape "
+                        f"{old_buf.keys.shape} != {want} and the edge is "
+                        f"not HASH-rescalable")
+                bufs[eidx] = old_buf
+        runner.executor.carry = carry._replace(
+            op_states=tuple(ops), edge_bufs=tuple(bufs))
+        return runner
+
     def state_digest(self) -> str:
         """Canonical digest of the recoverable job state: operator
         states, record counts, log heads and each log's live row window.
@@ -869,6 +1003,8 @@ class ClusterRunner:
         # Host epoch control plane mirrors the fence.
         self.epoch_tracker.inc_record_count(delta_records)
         self.epoch_tracker.start_new_epoch(self.executor.epoch_id)
+        if self.latency is not None:
+            self.latency.observe()
         # Checkpoint at the fence: the lean fence snapshot (op state +
         # offsets; logs/rings are truncated on completion, not persisted).
         self.coordinator.trigger(closed, self.executor.lean_snapshot(),
@@ -1973,8 +2109,7 @@ class ClusterRunner:
                 f"reader to re-read from")
         v = self.job.vertices[vid]
         b = v.operator.batch_size
-        anchors = np.where((rows[:, det.LANE_TAG] == det.TIMESTAMP)
-                           & (rows[:, det.LANE_RC] == 0))[0][:n_steps]
+        anchors = det.sync_anchors(rows)[:n_steps]
         counts = rows[anchors + 3, det.LANE_P].astype(np.int64)
         offset = int(np.asarray(snap.op_states[vid]["offset"][sub]))
         ch = self._chunk()
@@ -2141,9 +2276,7 @@ class ClusterRunner:
             ts_pos = np.arange(n // DETS_PER_STEP,
                                dtype=np.int64) * DETS_PER_STEP
         elif n > 0:
-            ts_pos = np.where(
-                (det_rows[:, det.LANE_TAG] == det.TIMESTAMP)
-                & (det_rows[:, det.LANE_RC] == 0))[0]
+            ts_pos = det.sync_anchors(det_rows)
         else:
             ts_pos = np.zeros((0,), np.int64)
         me = compiled.max_epochs

@@ -118,3 +118,47 @@ def test_metrics_http_endpoint_serves_prometheus_and_json():
             assert e.code == 404
     finally:
         ep.close()
+
+
+def test_latency_markers_populate_and_replay_stable(tmp_path):
+    """Latency markers ride the causal RNG path (reference
+    RecordWriter.randomEmit:131-137): marker steps are chosen by the
+    recorded per-step rng draws, so (a) the latency-ms histogram
+    populates on a live job, and (b) a recovered task's replayed rng
+    stream re-derives the SAME marker schedule bit-for-bit."""
+    import numpy as np
+    from clonos_tpu.api.environment import StreamEnvironment
+    from clonos_tpu.causal import determinant as det
+    from clonos_tpu.runtime.cluster import ClusterRunner, LatencyMarkers
+
+    env = StreamEnvironment(name="lat", num_key_groups=8,
+                            default_edge_capacity=32)
+    (env.synthetic_source(vocab=11, batch_size=4, parallelism=2)
+        .key_by().window_count(num_keys=11, window_size=1 << 30)
+        .sink())
+    r = ClusterRunner(env.build(), steps_per_epoch=8, log_capacity=512,
+                      max_epochs=8, inflight_ring_steps=32, seed=3,
+                      latency_marker_every=3)
+    r.run_epoch(complete_checkpoint=True)
+    r.run_epoch(complete_checkpoint=False)
+    assert r.latency.hist.count > 0
+
+    # Fail a window subtask; the recovered log's RNG lanes must yield the
+    # same marker schedule as the live step-input ledger over the
+    # replayed range.
+    fence = r._fence_step[r.standbys.latest.checkpoint_id + 1]
+    r.inject_failure([2 + 1])
+    report = r.recover()
+    mgr = report.managers[0]
+    n = report.steps_replayed
+    if mgr.plan.det_device is not None:
+        rngs = np.asarray(mgr.plan.det_device[1])[:n]
+    else:
+        rows = np.asarray(mgr.plan.det_rows)
+        anchors = det.sync_anchors(rows)[:n]
+        rngs = rows[anchors + 1, det.LANE_P]
+    live = [rg for (_t, rg) in
+            r.executor.step_input_history[fence:fence + n]]
+    assert LatencyMarkers.schedule(rngs.tolist(), 3) == \
+        LatencyMarkers.schedule(live, 3)
+    assert len(LatencyMarkers.schedule(live, 3)) > 0
