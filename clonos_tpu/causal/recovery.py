@@ -227,12 +227,7 @@ class LogReplayer:
         determinant rows (host-appended between supersteps)."""
         k = len(self.LAYOUT)
         tags = rows[:, det.LANE_TAG]
-        # Sync anchors: TIMESTAMP rows with record_count 0. Async appends
-        # (services) are stamped with a nonzero record count precisely so
-        # an async TimestampDeterminant can't masquerade as a step anchor
-        # (executor.append_async_determinant).
-        ts_idx = np.where((tags == det.TIMESTAMP)
-                          & (rows[:, det.LANE_RC] == 0))[0]
+        ts_idx = det.sync_anchors(rows)
         if len(ts_idx) < n:
             raise RecoveryError(
                 f"determinant log too short: need {n} superstep blocks, "

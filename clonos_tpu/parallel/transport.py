@@ -40,6 +40,8 @@ INFLIGHT_RESPONSE = 10
 SHUTDOWN = 11
 OK = 12
 ERROR = 13
+QUERY_STATE = 14           # external client -> queryable-state endpoint
+QUERY_RESPONSE = 15
 
 
 def _send(sock: socket.socket, mtype: int, payload: bytes) -> None:

@@ -929,6 +929,22 @@ class ClusterRunner:
             op_states=tuple(ops), edge_bufs=tuple(bufs))
         return runner
 
+    def attach_file_sink(self, vertex_id: int, root: str):
+        """Back a transactional sink with durable part files
+        (runtime/filesink.py — the StreamingFileSink analog): pendings
+        persist at every epoch seal, commits are atomic renames, and
+        stale pendings of a dead incarnation are swept now."""
+        from clonos_tpu.runtime.filesink import FileSystemSink
+        if vertex_id not in self.txn_logs:
+            raise ValueError(
+                f"vertex {vertex_id} is not a transactional sink")
+        fs = FileSystemSink(root)
+        tl = self.txn_logs[vertex_id]
+        tl.pre_committer = fs.write_pending
+        tl.committer = fs.commit
+        fs.sweep_pending(keep_epochs=tl.pending_epochs())
+        return fs
+
     def state_digest(self) -> str:
         """Canonical digest of the recoverable job state: operator
         states, record counts, log heads and each log's live row window.

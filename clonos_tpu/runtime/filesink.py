@@ -1,0 +1,106 @@
+"""Durable filesystem egress behind the 2PC sink — the
+StreamingFileSink / FileSystem-connector analog (reference
+flink-streaming-java .../functions/sink/filesystem/StreamingFileSink.java
++ the flink-connector-filesystem bucketing sink): exactly-once part
+files via the write-pending / atomic-rename-on-commit protocol.
+
+Protocol (riding runtime/txn.py's TransactionLog hooks):
+
+- **pre-commit** (epoch seal): every subtask shard of the sealed epoch is
+  written to ``part-<epoch>-<sub>.pending`` — durably on disk BEFORE the
+  checkpoint can complete, the reference's preCommit-on-snapshot promise.
+- **commit** (checkpoint complete): each pending part is atomically
+  renamed to ``part-<epoch>-<sub>.final`` (``os.replace``). Only
+  ``.final`` files are observable output; a consumer can never see data
+  of an epoch whose checkpoint didn't complete.
+- **abort / recovery**: a sink-subtask failure rebuilds its shards from
+  replay (TransactionLog.rebuild_shard) and re-seals — the pending part
+  is simply overwritten with the bit-identical replayed bytes. A process
+  restart calls :meth:`sweep_pending`, deleting pendings of epochs that
+  will never commit (the recoverAndAbort pass).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+
+class FileSystemSink:
+    """One sink vertex's durable part-file store."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _part(self, epoch: int, sub: int, state: str) -> str:
+        return os.path.join(self.root, f"part-{epoch}-{sub}.{state}")
+
+    # --- TransactionLog hooks ------------------------------------------------
+
+    def write_pending(self, epoch: int,
+                      shards: Dict[int, np.ndarray]) -> None:
+        """Pre-commit: persist every subtask shard of the sealed epoch
+        (atomic per-file: temp + replace, so a crash mid-write never
+        leaves a torn pending)."""
+        for sub, rows in shards.items():
+            path = self._part(epoch, sub, "pending")
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as f:
+                np.save(f, np.asarray(rows, np.int32))
+            os.replace(tmp, path)
+
+    def commit(self, epoch: int, _rows: np.ndarray) -> None:
+        """Checkpoint complete: pendings of ``epoch`` become final,
+        atomically, subtask-major."""
+        for fn in sorted(os.listdir(self.root)):
+            if fn.startswith(f"part-{epoch}-") and fn.endswith(".pending"):
+                src = os.path.join(self.root, fn)
+                os.replace(src, src[:-len(".pending")] + ".final")
+
+    # --- restart / observation ----------------------------------------------
+
+    def sweep_pending(self, keep_epochs: Sequence[int] = ()) -> List[str]:
+        """Startup recovery: delete pendings whose epoch is not in
+        ``keep_epochs`` (their checkpoint will never complete — the
+        recoverAndAbort pass). Returns the removed filenames."""
+        keep = set(keep_epochs)
+        removed = []
+        for fn in sorted(os.listdir(self.root)):
+            if fn.endswith(".tmp"):
+                # A crash between temp write and rename leaves an orphan
+                # that would otherwise accumulate forever.
+                os.remove(os.path.join(self.root, fn))
+                removed.append(fn)
+                continue
+            if not fn.endswith(".pending"):
+                continue
+            epoch = int(fn.split("-")[1])
+            if epoch not in keep:
+                os.remove(os.path.join(self.root, fn))
+                removed.append(fn)
+        return removed
+
+    def committed_epochs(self) -> List[int]:
+        out = set()
+        for fn in os.listdir(self.root):
+            if fn.endswith(".final"):
+                out.add(int(fn.split("-")[1]))
+        return sorted(out)
+
+    def read_committed(self) -> np.ndarray:
+        """Every committed record in (epoch, subtask) order — what an
+        external consumer observes."""
+        parts: List[Tuple[int, int, str]] = []
+        for fn in os.listdir(self.root):
+            if fn.endswith(".final"):
+                stem = fn[: -len(".final")]
+                _, e, s = stem.split("-")
+                parts.append((int(e), int(s), fn))
+        rows = [np.load(os.path.join(self.root, fn))
+                for _e, _s, fn in sorted(parts)]
+        rows = [r for r in rows if r.shape[0]]
+        return (np.concatenate(rows, axis=0) if rows
+                else np.zeros((0, 3), np.int32))

@@ -1,0 +1,142 @@
+"""Leader election + HA services for the JobMaster.
+
+Reference: flink-runtime .../leaderelection/ +
+.../highavailability/ (StandaloneLeaderElectionService /
+ZooKeeperLeaderElectionService): exactly one JobMaster leads at a time;
+a standby takes over when the leader's lease lapses; every grant carries
+a monotonically increasing **fencing token** that stale leaders' actions
+are rejected by (the reference's leader session id).
+
+This is the file-lease implementation (the shared-filesystem analog of
+the ZK lock — the deployment unit here is hosts sharing a durable
+directory, the same place checkpoints live): the lease file holds
+``{leader_id, epoch, deadline}``; acquisition atomically replaces an
+absent or EXPIRED lease with ``epoch + 1`` (os.replace — last writer
+wins, and the epoch check makes a lost race visible to the loser);
+renewal extends the deadline only while the epoch still matches (a
+deposed leader's renew fails instead of silently split-braining)."""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Optional
+
+
+class FileLeaderElection:
+    """One contender's handle on a lease-file election."""
+
+    def __init__(self, path: str, contender_id: str,
+                 lease_ttl_s: float = 2.0,
+                 clock=time.monotonic):
+        self.path = path
+        self.contender_id = contender_id
+        self.ttl = lease_ttl_s
+        self._clock = clock
+        #: fencing token of OUR current leadership (None = not leader)
+        self.epoch: Optional[int] = None
+
+    # --- lease file ----------------------------------------------------------
+
+    def _read(self) -> Optional[dict]:
+        try:
+            with open(self.path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def _write(self, rec: dict) -> None:
+        tmp = f"{self.path}.{self.contender_id}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(rec, f)
+        os.replace(tmp, self.path)
+
+    # --- contender API -------------------------------------------------------
+
+    def _claim(self, epoch: int) -> bool:
+        """Atomically claim fencing epoch ``epoch``: O_CREAT|O_EXCL on a
+        per-epoch claim file — the filesystem arbitrates, so two
+        contenders racing on one expired lease can NEVER both win the
+        same epoch (the split-brain hole a write-then-re-read protocol
+        leaves open)."""
+        try:
+            fd = os.open(f"{self.path}.epoch{epoch}.claim",
+                         os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        except FileExistsError:
+            return False
+        os.close(fd)
+        return True
+
+    def _max_claimed(self) -> int:
+        """Highest epoch any contender ever claimed — a claimant that
+        crashed between claim and lease write must not wedge the
+        election (the next acquisition goes one higher)."""
+        d = os.path.dirname(self.path) or "."
+        base = os.path.basename(self.path) + ".epoch"
+        hi = 0
+        try:
+            for fn in os.listdir(d):
+                if fn.startswith(base) and fn.endswith(".claim"):
+                    hi = max(hi, int(fn[len(base):-len(".claim")]))
+        except OSError:
+            pass
+        return hi
+
+    def try_acquire(self) -> bool:
+        """Become leader iff the lease is absent, expired, or already
+        ours. Returns True when this contender now leads; ``epoch`` is
+        the fencing token to stamp outgoing actions with."""
+        cur = self._read()
+        now = self._clock()
+        if cur is not None and cur["deadline"] > now \
+                and cur["leader_id"] != self.contender_id:
+            return False
+        if cur is not None and cur["leader_id"] == self.contender_id \
+                and cur["deadline"] > now:
+            # Still ours: extend under the existing token.
+            self.epoch = cur["epoch"]
+            self._write({"leader_id": self.contender_id,
+                         "epoch": self.epoch,
+                         "deadline": now + self.ttl})
+            return True
+        new_epoch = max(cur["epoch"] if cur is not None else 0,
+                        self._max_claimed()) + 1
+        if not self._claim(new_epoch):
+            self.epoch = None
+            return False               # lost the race for this epoch
+        self._write({"leader_id": self.contender_id, "epoch": new_epoch,
+                     "deadline": now + self.ttl})
+        self.epoch = new_epoch
+        return True
+
+    def renew(self) -> bool:
+        """Extend our lease. Fails (and drops leadership) if the lease
+        was taken over — the fencing epoch moved past ours."""
+        if self.epoch is None:
+            return False
+        cur = self._read()
+        if cur is None or cur["leader_id"] != self.contender_id \
+                or cur["epoch"] != self.epoch:
+            self.epoch = None
+            return False
+        self._write({"leader_id": self.contender_id, "epoch": self.epoch,
+                     "deadline": self._clock() + self.ttl})
+        return True
+
+    def is_leader(self) -> bool:
+        return self.epoch is not None
+
+    def leader(self) -> Optional[str]:
+        """Current lease holder (None when absent/expired)."""
+        cur = self._read()
+        if cur is None or cur["deadline"] <= self._clock():
+            return None
+        return cur["leader_id"]
+
+    def fencing_valid(self, epoch: int) -> bool:
+        """Would an action stamped with ``epoch`` be accepted now? (The
+        receiver-side check: reject anything below the current lease
+        epoch — a deposed leader's late RPCs.)"""
+        cur = self._read()
+        return cur is not None and epoch >= cur["epoch"]

@@ -1,0 +1,106 @@
+"""Queryable state: external point lookups of live keyed state.
+
+Reference: flink-runtime/src/main/java/org/apache/flink/runtime/query/
+(KvStateRegistry + QueryableStateClient — an external client resolves
+(job, state name, key) to the owning subtask and reads its keyed state).
+
+TPU mapping: keyed state is dense per-key tables ``[P, K]`` on device; a
+server thread must not touch device state, so the endpoint serves a
+FENCE SNAPSHOT the main loop refreshes (``refresh()`` at epoch
+boundaries — the same discipline as HostLogEndpoint). A lookup resolves
+the key's owning subtask with the SAME key-group assignment the exchange
+uses, so the served value is exactly the owning task's table entry. The
+snapshot is epoch-stamped: clients see which fence their read is from
+(the reference's client reads are similarly only
+checkpoint-consistent)."""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from clonos_tpu.parallel import transport as tp
+from clonos_tpu.parallel.routing import hash32_np
+
+
+class QueryableStateEndpoint:
+    """Serves (vertex, state_name, key) lookups over the control
+    transport."""
+
+    def __init__(self, runner, host: str = "127.0.0.1", port: int = 0):
+        self.runner = runner
+        self._lock = threading.Lock()
+        self._snap: Dict[Tuple[int, str], np.ndarray] = {}
+        self._epoch = -1
+        self.refresh()
+        self.server = tp.ControlServer(self._handle, host, port)
+        self.address = self.server.address
+
+    def refresh(self) -> None:
+        """Main-thread fence snapshot of every vertex's array states."""
+        snap: Dict[Tuple[int, str], np.ndarray] = {}
+        for v in self.runner.job.vertices:
+            st = self.runner.executor.vertex_state(v.vertex_id)
+            if not isinstance(st, dict):
+                continue
+            for name, arr in st.items():
+                snap[(v.vertex_id, name)] = np.asarray(arr)
+        with self._lock:
+            self._snap = snap
+            self._epoch = self.runner.executor.epoch_id
+
+    def _handle(self, mtype: int, payload: bytes) -> Tuple[int, bytes]:
+        if mtype != tp.QUERY_STATE:
+            return tp.ERROR, tp.pack_json({"error": f"bad mtype {mtype}"})
+        req = tp.unpack_json(payload)
+        vid = req["vertex"]
+        name = req.get("state", "acc")
+        key = req["key"]
+        with self._lock:
+            arr = self._snap.get((vid, name))
+            epoch = self._epoch
+        if arr is None:
+            return tp.ERROR, tp.pack_json(
+                {"error": f"no state ({vid}, {name})"})
+        job = self.runner.job
+        p = job.vertices[vid].parallelism
+        if arr.ndim < 2 or arr.shape[0] != p or not (
+                0 <= key < arr.shape[-1]):
+            return tp.ERROR, tp.pack_json(
+                {"error": f"state ({vid}, {name}) of shape "
+                          f"{list(arr.shape)} is not keyed or key "
+                          f"{key} out of range"})
+        # Host-side (numpy) key->owner math: a server thread must never
+        # dispatch device work (jax is main-thread-only on some
+        # backends; hash32_np is the exchange hash's host twin).
+        kg = int(hash32_np(np.asarray(key, np.int64))
+                 % job.num_key_groups)
+        sub = (kg * p) // job.num_key_groups
+        val = arr[sub, ..., key]
+        return tp.QUERY_RESPONSE, tp.pack_json(
+            {"value": np.asarray(val).tolist(), "subtask": sub,
+             "key_group": kg, "epoch": epoch})
+
+    def close(self) -> None:
+        self.server.close()
+
+
+class QueryableStateClient:
+    """External lookup client (QueryableStateClient analog)."""
+
+    def __init__(self, address: Tuple[str, int]):
+        self._client = tp.ControlClient(tuple(address))
+
+    def query(self, vertex: int, key: int,
+              state: str = "acc") -> dict:
+        rt, resp = self._client.call(tp.QUERY_STATE, tp.pack_json(
+            {"vertex": vertex, "state": state, "key": key}))
+        out = tp.unpack_json(resp)
+        if rt == tp.ERROR:
+            raise KeyError(out["error"])
+        return out
+
+    def close(self) -> None:
+        self._client.close()

@@ -138,6 +138,9 @@ class HostLogEndpoint:
         self._lock = threading.Lock()
         self._rows: Dict[int, np.ndarray] = {}    # flat -> [n, lanes]
         self._starts: Dict[int, int] = {}         # abs offset of rows[0]
+        #: ring -> (window start step, window end step, field arrays) —
+        #: the served in-flight tail (refresh_inflight)
+        self._inflight: Dict[int, Tuple[int, int, Optional[dict]]] = {}
         self.refresh()
         self.server = tp.ControlServer(self._handle, host, port)
         self.address = self.server.address
@@ -160,7 +163,63 @@ class HostLogEndpoint:
             self._rows = snap_rows
             self._starts = snap_starts
 
+    def refresh_inflight(self, max_steps: int = 256) -> None:
+        """Main-thread snapshot of every in-flight ring's tail window
+        (bounded to ``max_steps``) for remote serving — the wire analog
+        of the reference's InFlightLogRequestEvent path, where a
+        recovering task pulls lost inputs from a REMOTE upstream
+        (flink-runtime .../causal/events/InFlightLogRequestEvent.java +
+        backwards task events)."""
+        from clonos_tpu.inflight import log as ifl
+        import jax.numpy as jnp
+        snap: Dict[int, Tuple[int, int, Optional[dict]]] = {}
+        for ri, el in enumerate(self.executor.carry.out_rings):
+            head, tail = int(el.head), int(el.tail)
+            lo = max(tail, head - max_steps)
+            if head <= lo:
+                snap[ri] = (lo, head, None)
+                continue
+            batch, _, _ = ifl.slice_steps(el, jnp.asarray(lo, jnp.int32),
+                                          max_steps)
+            snap[ri] = (lo, head, {
+                "keys": np.asarray(batch.keys)[:head - lo],
+                "values": np.asarray(batch.values)[:head - lo],
+                "timestamps": np.asarray(batch.timestamps)[:head - lo],
+                "valid": np.asarray(batch.valid)[:head - lo]})
+        with self._lock:
+            self._inflight = snap
+
+    def _handle_inflight(self, payload: bytes) -> Tuple[int, bytes]:
+        req = tp.unpack_json(payload)
+        ri, start, count = req["ring"], req["start"], req["count"]
+        with self._lock:
+            win = self._inflight.get(ri)
+        if win is None:
+            return tp.ERROR, tp.pack_json(
+                {"error": f"no in-flight snapshot for ring {ri}"})
+        lo, head, fields = win
+        got_lo = max(start, lo)
+        got_hi = min(start + count, head)
+        if fields is None or got_hi <= got_lo:
+            hdr = tp.pack_json({"ring": ri, "start": got_lo, "count": 0,
+                                "floor": lo})
+            return tp.INFLIGHT_RESPONSE, (
+                len(hdr).to_bytes(4, "little") + hdr)
+        sl = slice(got_lo - lo, got_hi - lo)
+        k = np.ascontiguousarray(fields["keys"][sl], np.int32)
+        v = np.ascontiguousarray(fields["values"][sl], np.int32)
+        t = np.ascontiguousarray(fields["timestamps"][sl], np.int32)
+        m = np.ascontiguousarray(fields["valid"][sl], np.uint8)
+        hdr = tp.pack_json({"ring": ri, "start": got_lo,
+                            "count": got_hi - got_lo, "floor": lo,
+                            "shape": list(k.shape)})
+        return tp.INFLIGHT_RESPONSE, (
+            len(hdr).to_bytes(4, "little") + hdr
+            + k.tobytes() + v.tobytes() + t.tobytes() + m.tobytes())
+
     def _handle(self, mtype: int, payload: bytes) -> Tuple[int, bytes]:
+        if mtype == tp.INFLIGHT_REQUEST:
+            return self._handle_inflight(payload)
         if mtype != tp.DETERMINANT_REQUEST:
             return tp.ERROR, tp.pack_json({"error": f"bad mtype {mtype}"})
         req = tp.unpack_json(payload)
@@ -301,6 +360,34 @@ class RemoteReplicaMirror:
         source form ClusterRunner.bootstrap_standby consumes."""
         log = self._replicas[flat]
         return (self.rows(flat), int(log.tail))
+
+    def fetch_inflight(self, ring: int, start: int, count: int
+                       ) -> Tuple[int, Optional[dict]]:
+        """Pull a window of a remote upstream's in-flight log (the
+        InFlightLogRequestEvent wire analog): returns
+        (absolute start of the served window, field dict with
+        keys/values/timestamps [n, P, cap] int32 + valid [n, P, cap]
+        bool), or (floor, None) when the requested range holds no
+        retained steps."""
+        rt, resp = self._client.call(
+            tp.INFLIGHT_REQUEST,
+            tp.pack_json({"ring": ring, "start": start, "count": count}))
+        if rt == tp.ERROR:
+            raise RuntimeError(tp.unpack_json(resp)["error"])
+        hlen = int.from_bytes(resp[:4], "little")
+        hdr = tp.unpack_json(resp[4: 4 + hlen])
+        if hdr["count"] == 0:
+            return hdr["floor"], None
+        shape = tuple(hdr["shape"])
+        n = int(np.prod(shape)) * 4
+        body = resp[4 + hlen:]
+        k = np.frombuffer(body[:n], np.int32).reshape(shape)
+        v = np.frombuffer(body[n:2 * n], np.int32).reshape(shape)
+        t = np.frombuffer(body[2 * n:3 * n], np.int32).reshape(shape)
+        m = np.frombuffer(body[3 * n:3 * n + n // 4],
+                          np.uint8).reshape(shape).astype(bool)
+        return hdr["start"], {"keys": k, "values": v, "timestamps": t,
+                              "valid": m}
 
     def sync(self) -> int:
         """One pull round: request each owned log's suffix past our head,

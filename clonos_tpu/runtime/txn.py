@@ -43,6 +43,12 @@ class TransactionLog:
                  = None):
         self.vertex_id = vertex_id
         self.committer = committer
+        #: pre-commit hook, called at seal with the epoch's per-subtask
+        #: shards — durable sinks persist pending parts here BEFORE the
+        #: checkpoint can complete (runtime/filesink.py; the reference's
+        #: preCommit-on-snapshot durability promise).
+        self.pre_committer: Optional[
+            Callable[[int, Dict[int, np.ndarray]], None]] = None
         self._pending: Dict[int, _Txn] = {}
         self.committed: List[Tuple[int, np.ndarray]] = []
 
@@ -66,7 +72,16 @@ class TransactionLog:
     def seal(self, epoch: int) -> None:
         """Epoch fence: the transaction stops accepting records
         (pre-commit; reference preCommit on snapshot)."""
-        self._pending.setdefault(epoch, _Txn(epoch)).sealed = True
+        txn = self._pending.setdefault(epoch, _Txn(epoch))
+        txn.sealed = True
+        if self.pre_committer is not None:
+            self.pre_committer(epoch, self._merged_shards(txn))
+
+    @staticmethod
+    def _merged_shards(txn: _Txn) -> Dict[int, np.ndarray]:
+        return {s: (np.concatenate(txn.shards[s], axis=0)
+                    if txn.shards[s] else np.zeros((0, 3), np.int32))
+                for s in sorted(txn.shards)}
 
     # --- commit / abort ------------------------------------------------------
 
@@ -98,9 +113,14 @@ class TransactionLog:
 
     def rebuild_shard(self, epoch: int, sub: int,
                       records: np.ndarray) -> None:
-        """Install a replay-reconstructed shard for (epoch, subtask)."""
+        """Install a replay-reconstructed shard for (epoch, subtask) —
+        and re-persist its pending part if the epoch already sealed (the
+        replayed bytes are bit-identical; the overwrite is the abort +
+        regenerate of the reference's recoverAndAbort)."""
         txn = self._pending.setdefault(epoch, _Txn(epoch))
         txn.shards[sub] = [records]
+        if txn.sealed and self.pre_committer is not None:
+            self.pre_committer(epoch, {sub: np.asarray(records, np.int32)})
 
     # --- introspection -------------------------------------------------------
 

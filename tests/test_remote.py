@@ -319,3 +319,46 @@ def test_host_loss_rebuild_from_mirror_and_checkpoint(tmp_path):
             proc.kill()
         ctl.close()
         jm.close()
+
+
+def test_inflight_log_wire_request():
+    """The InFlightLogRequestEvent wire analog: a remote peer pulls a
+    window of an upstream's in-flight ring over TCP and gets the exact
+    device-ring bytes (reference
+    .../causal/events/InFlightLogRequestEvent.java — a recovering task's
+    lost inputs can come from a REMOTE upstream)."""
+    import jax
+    import jax.numpy as jnp
+    from clonos_tpu.api.environment import StreamEnvironment
+    from clonos_tpu.inflight import log as ifl
+    from clonos_tpu.runtime.cluster import ClusterRunner
+    from clonos_tpu.runtime.remote import HostLogEndpoint
+
+    env = StreamEnvironment(name="ifl-wire", num_key_groups=8,
+                            default_edge_capacity=32)
+    (env.synthetic_source(vocab=13, batch_size=4, parallelism=2)
+        .key_by().window_count(num_keys=13, window_size=1 << 30).sink())
+    r = ClusterRunner(env.build(), steps_per_epoch=6, log_capacity=256,
+                      max_epochs=8, inflight_ring_steps=16, seed=3)
+    r.run_epoch(complete_checkpoint=True)
+    r.run_epoch(complete_checkpoint=False)
+    ep = HostLogEndpoint(r.executor)
+    ep.refresh_inflight(max_steps=8)
+    try:
+        mirror = RemoteReplicaMirror(ep.address, flats=[0], capacity=256,
+                                     max_epochs=8)
+        start, fields = mirror.fetch_inflight(ring=0, start=0, count=64)
+        assert fields is not None
+        el = r.executor.carry.out_rings[0]
+        n = fields["keys"].shape[0]
+        want, _, _ = ifl.slice_steps(el, jnp.asarray(start, jnp.int32), n)
+        np.testing.assert_array_equal(fields["keys"],
+                                      np.asarray(want.keys)[:n])
+        np.testing.assert_array_equal(fields["valid"],
+                                      np.asarray(want.valid)[:n])
+        # Range below the retained floor comes back empty, with the floor.
+        floor, none = mirror.fetch_inflight(ring=0, start=-100, count=2)
+        assert none is None and floor >= 0
+        mirror.close()
+    finally:
+        ep.close()

@@ -87,3 +87,59 @@ def test_window_failure_leaves_sink_transactions_intact():
     np.testing.assert_array_equal(
         r.txn_logs[_sink_vid(r)].committed_stream(),
         golden.txn_logs[_sink_vid(golden)].committed_stream())
+
+
+def test_file_sink_exactly_once_across_failure(tmp_path):
+    """Durable part-file egress (StreamingFileSink analog): pendings at
+    every seal, atomic finals at commit; a sink failure mid-pending ends
+    with committed FILES bit-identical to a never-failed run's; only
+    .final files are ever observable; stale pendings sweep on restart."""
+    import os
+    golden = _runner()
+    gd = str(tmp_path / "golden")
+    gfs = golden.attach_file_sink(_sink_vid(golden), gd)
+    r = _runner()
+    rd = str(tmp_path / "failed")
+    rfs = r.attach_file_sink(_sink_vid(r), rd)
+    for rr in (golden, r):
+        rr.run_epoch()                           # epoch 0 commits
+        rr.run_epoch(complete_checkpoint=False)  # epoch 1 pending
+        rr.run_epoch(complete_checkpoint=False)  # epoch 2 pending
+    # Pendings are durable BEFORE their checkpoints complete.
+    assert any(f.endswith(".pending") for f in os.listdir(rd))
+    assert rfs.committed_epochs() == [0]
+
+    sink_base = r.job.subtask_base(_sink_vid(r))
+    r.inject_failure([sink_base + 1])
+    r.recover()                 # ignores the dead task's unacked ckpts
+    # Epochs 1-2 commit with the NEXT completed checkpoint (an ignored
+    # checkpoint can never complete) — run epoch 3 to completion on both.
+    for rr in (golden, r):
+        rr.run_epoch(complete_checkpoint=True)
+    assert rfs.committed_epochs() == gfs.committed_epochs() \
+        == [0, 1, 2, 3]
+    np.testing.assert_array_equal(rfs.read_committed(),
+                                  gfs.read_committed())
+    # Nothing pending remains; a restart sweep finds nothing to remove.
+    assert not any(f.endswith(".pending") for f in os.listdir(rd))
+    assert rfs.sweep_pending() == []
+
+
+def test_file_sink_sweeps_stale_pendings_on_restart(tmp_path):
+    """A dead incarnation's sealed-but-never-committed pendings must not
+    survive into the next incarnation's observable output."""
+    import os
+    root = str(tmp_path / "sink")
+    r = _runner()
+    fs = r.attach_file_sink(_sink_vid(r), root)
+    r.run_epoch()                                # epoch 0 commits
+    r.run_epoch(complete_checkpoint=False)       # epoch 1 pending, dies
+    assert any(f.endswith(".pending") for f in os.listdir(root))
+    committed_before = fs.read_committed()
+
+    # New incarnation over the same directory: pendings of epochs it is
+    # not resuming are aborted (recoverAndAbort).
+    r2 = _runner()
+    fs2 = r2.attach_file_sink(_sink_vid(r2), root)
+    assert not any(f.endswith(".pending") for f in os.listdir(root))
+    np.testing.assert_array_equal(fs2.read_committed(), committed_before)
