@@ -140,6 +140,33 @@ def append_full(state: ThreadLogState, rows: jnp.ndarray) -> ThreadLogState:
     if n > cap:
         raise ValueError(f"bulk append of {n} rows > capacity {cap}")
     if n * 64 >= cap:
+        w = 1 << (2 * n - 1).bit_length()     # pow2 window >= 2n
+        if 4 * w <= cap:
+            # Windowed RMW: the chunk spans at most two W-aligned ring
+            # windows; roll it within a [2W] strip and read-merge-write
+            # those two windows at their (traced, aligned) starts. Work
+            # is O(W) = O(n) per append — the whole-capacity
+            # pad/roll/select below costs O(capacity), which doubled the
+            # live append bill when log capacities grew to 1<<17.
+            o = state.head & (cap - 1)
+            r = o & (w - 1)
+            base = o - r                        # W-aligned, traced
+            strip = jnp.pad(rows, ((0, 2 * w - n), (0, 0)))
+            strip = jnp.roll(strip, r, axis=0)
+            idx2 = jnp.arange(2 * w, dtype=jnp.int32)
+            mask = (idx2 >= r) & (idx2 < r + n)
+            out = state.rows
+            for half in (0, 1):
+                start = (base + half * w) & (cap - 1)
+                seg = jax.lax.dynamic_slice_in_dim(strip, half * w, w)
+                m = jax.lax.dynamic_slice_in_dim(mask, half * w, w)
+                win = jax.lax.dynamic_slice(
+                    out, (start, jnp.zeros((), jnp.int32)),
+                    (w, NUM_LANES))
+                merged = jnp.where(m[:, None], seg, win)
+                out = jax.lax.dynamic_update_slice(
+                    out, merged, (start, jnp.zeros((), jnp.int32)))
+            return state._replace(rows=out, head=state.head + n)
         o = state.head & (cap - 1)
         padded = jnp.pad(rows, ((0, cap - n), (0, 0)))
         rolled = jnp.roll(padded, o, axis=0)

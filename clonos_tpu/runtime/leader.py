@@ -7,25 +7,35 @@ a standby takes over when the leader's lease lapses; every grant carries
 a monotonically increasing **fencing token** that stale leaders' actions
 are rejected by (the reference's leader session id).
 
-This is the file-lease implementation (the shared-filesystem analog of
-the ZK lock — the deployment unit here is hosts sharing a durable
-directory, the same place checkpoints live): the lease file holds
-``{leader_id, epoch, deadline}``; acquisition atomically replaces an
-absent or EXPIRED lease with ``epoch + 1`` (os.replace — last writer
-wins, and the epoch check makes a lost race visible to the loser);
-renewal extends the deadline only while the epoch still matches (a
-deposed leader's renew fails instead of silently split-braining)."""
+File-lease implementation (the shared-filesystem analog of the ZK lock —
+the deployment unit here is hosts sharing the durable directory
+checkpoints live in). The design makes split-brain STRUCTURALLY
+impossible rather than racily unlikely:
+
+- every fencing epoch is one file, ``<path>.epoch<N>.claim``, created
+  with O_CREAT|O_EXCL — the filesystem arbitrates, so an epoch has
+  exactly one owner, ever;
+- the claim file IS the lease: its content ``{leader_id, deadline}`` is
+  rewritten (atomic tmp+replace) only by its owner on renewal — there is
+  no shared lease file two writers could race on, which is exactly the
+  TOCTOU a central lease record cannot avoid;
+- the current leader is the OWNER OF THE HIGHEST epoch whose deadline
+  has not lapsed; a deposed leader renewing its old epoch's file changes
+  nothing any reader looks at, and ``fencing_valid`` rejects tokens
+  below the highest claimed epoch;
+- acquisition claims ``highest + 1`` and garbage-collects claims more
+  than one epoch behind (superseded claims can never be read again)."""
 
 from __future__ import annotations
 
 import json
 import os
 import time
-from typing import Optional
+from typing import List, Optional, Tuple
 
 
 class FileLeaderElection:
-    """One contender's handle on a lease-file election."""
+    """One contender's handle on a claim-file election."""
 
     def __init__(self, path: str, contender_id: str,
                  lease_ttl_s: float = 2.0,
@@ -37,91 +47,107 @@ class FileLeaderElection:
         #: fencing token of OUR current leadership (None = not leader)
         self.epoch: Optional[int] = None
 
-    # --- lease file ----------------------------------------------------------
+    # --- claim files ---------------------------------------------------------
 
-    def _read(self) -> Optional[dict]:
-        try:
-            with open(self.path) as f:
-                return json.load(f)
-        except (OSError, ValueError):
-            return None
+    def _claim_path(self, epoch: int) -> str:
+        return f"{self.path}.epoch{epoch}.claim"
 
-    def _write(self, rec: dict) -> None:
-        tmp = f"{self.path}.{self.contender_id}.tmp"
-        with open(tmp, "w") as f:
-            json.dump(rec, f)
-        os.replace(tmp, self.path)
-
-    # --- contender API -------------------------------------------------------
-
-    def _claim(self, epoch: int) -> bool:
-        """Atomically claim fencing epoch ``epoch``: O_CREAT|O_EXCL on a
-        per-epoch claim file — the filesystem arbitrates, so two
-        contenders racing on one expired lease can NEVER both win the
-        same epoch (the split-brain hole a write-then-re-read protocol
-        leaves open)."""
-        try:
-            fd = os.open(f"{self.path}.epoch{epoch}.claim",
-                         os.O_CREAT | os.O_EXCL | os.O_WRONLY)
-        except FileExistsError:
-            return False
-        os.close(fd)
-        return True
-
-    def _max_claimed(self) -> int:
-        """Highest epoch any contender ever claimed — a claimant that
-        crashed between claim and lease write must not wedge the
-        election (the next acquisition goes one higher)."""
+    def _claims(self) -> List[int]:
         d = os.path.dirname(self.path) or "."
         base = os.path.basename(self.path) + ".epoch"
-        hi = 0
+        out = []
         try:
             for fn in os.listdir(d):
                 if fn.startswith(base) and fn.endswith(".claim"):
-                    hi = max(hi, int(fn[len(base):-len(".claim")]))
+                    out.append(int(fn[len(base):-len(".claim")]))
         except OSError:
             pass
-        return hi
+        return sorted(out)
+
+    def _read_claim(self, epoch: int) -> Optional[dict]:
+        """Claim content, or a conservative placeholder while its owner
+        is still between O_EXCL create and content write (treat as live
+        until the creation-time grace lapses — never steal mid-write)."""
+        p = self._claim_path(epoch)
+        try:
+            with open(p) as f:
+                rec = json.load(f)
+            rec["epoch"] = epoch
+            return rec
+        except ValueError:
+            try:
+                return {"leader_id": None, "epoch": epoch,
+                        "deadline_wall": os.path.getmtime(p) + self.ttl,
+                        "pending": True}
+            except OSError:
+                return None
+        except OSError:
+            return None
+
+    def _write_own(self, epoch: int, deadline: float) -> None:
+        # Single writer: only the O_EXCL winner of ``epoch`` ever writes
+        # this file, so the replace cannot race another contender.
+        tmp = f"{self._claim_path(epoch)}.{self.contender_id}.tmp"
+        with open(tmp, "w") as f:
+            json.dump({"leader_id": self.contender_id,
+                       "deadline": deadline}, f)
+        os.replace(tmp, self._claim_path(epoch))
+
+    def _current(self) -> Optional[dict]:
+        """The highest-epoch claim record (the authoritative lease)."""
+        claims = self._claims()
+        return self._read_claim(claims[-1]) if claims else None
+
+    def _expired(self, rec: dict) -> bool:
+        if rec.get("pending"):
+            # Grace keyed to wall time (mtime); the injected clock does
+            # not apply to a foreign writer mid-create.
+            return time.time() > rec["deadline_wall"]
+        return self._clock() > rec["deadline"]
+
+    # --- contender API -------------------------------------------------------
 
     def try_acquire(self) -> bool:
-        """Become leader iff the lease is absent, expired, or already
-        ours. Returns True when this contender now leads; ``epoch`` is
-        the fencing token to stamp outgoing actions with."""
-        cur = self._read()
-        now = self._clock()
-        if cur is not None and cur["deadline"] > now \
-                and cur["leader_id"] != self.contender_id:
+        """Become leader iff no live higher claim exists. True when this
+        contender now leads; ``epoch`` is the fencing token."""
+        cur = self._current()
+        if cur is not None and not self._expired(cur):
+            if cur.get("leader_id") == self.contender_id:
+                self.epoch = cur["epoch"]
+                self._write_own(self.epoch, self._clock() + self.ttl)
+                return True
             return False
-        if cur is not None and cur["leader_id"] == self.contender_id \
-                and cur["deadline"] > now:
-            # Still ours: extend under the existing token.
-            self.epoch = cur["epoch"]
-            self._write({"leader_id": self.contender_id,
-                         "epoch": self.epoch,
-                         "deadline": now + self.ttl})
-            return True
-        new_epoch = max(cur["epoch"] if cur is not None else 0,
-                        self._max_claimed()) + 1
-        if not self._claim(new_epoch):
+        new_epoch = (cur["epoch"] + 1) if cur is not None else 1
+        try:
+            fd = os.open(self._claim_path(new_epoch),
+                         os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        except FileExistsError:
             self.epoch = None
             return False               # lost the race for this epoch
-        self._write({"leader_id": self.contender_id, "epoch": new_epoch,
-                     "deadline": now + self.ttl})
+        os.close(fd)
+        self._write_own(new_epoch, self._clock() + self.ttl)
         self.epoch = new_epoch
+        # Superseded claims (< epoch-1) can never be read again.
+        for e in self._claims():
+            if e < new_epoch - 1:
+                try:
+                    os.remove(self._claim_path(e))
+                except OSError:
+                    pass
         return True
 
     def renew(self) -> bool:
-        """Extend our lease. Fails (and drops leadership) if the lease
-        was taken over — the fencing epoch moved past ours."""
+        """Extend our lease by rewriting OUR OWN epoch's claim — a no-op
+        for every reader if a higher epoch was claimed meanwhile (the
+        takeover can never be clobbered). Returns False and drops
+        leadership once superseded."""
         if self.epoch is None:
             return False
-        cur = self._read()
-        if cur is None or cur["leader_id"] != self.contender_id \
-                or cur["epoch"] != self.epoch:
-            self.epoch = None
+        claims = self._claims()
+        if not claims or claims[-1] != self.epoch:
+            self.epoch = None          # deposed: a higher claim exists
             return False
-        self._write({"leader_id": self.contender_id, "epoch": self.epoch,
-                     "deadline": self._clock() + self.ttl})
+        self._write_own(self.epoch, self._clock() + self.ttl)
         return True
 
     def is_leader(self) -> bool:
@@ -129,14 +155,14 @@ class FileLeaderElection:
 
     def leader(self) -> Optional[str]:
         """Current lease holder (None when absent/expired)."""
-        cur = self._read()
-        if cur is None or cur["deadline"] <= self._clock():
+        cur = self._current()
+        if cur is None or self._expired(cur):
             return None
-        return cur["leader_id"]
+        return cur.get("leader_id")
 
     def fencing_valid(self, epoch: int) -> bool:
         """Would an action stamped with ``epoch`` be accepted now? (The
-        receiver-side check: reject anything below the current lease
+        receiver-side check: reject anything below the highest claimed
         epoch — a deposed leader's late RPCs.)"""
-        cur = self._read()
-        return cur is not None and epoch >= cur["epoch"]
+        claims = self._claims()
+        return bool(claims) and epoch >= claims[-1]

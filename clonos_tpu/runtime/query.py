@@ -22,7 +22,7 @@ from typing import Dict, Optional, Tuple
 import numpy as np
 
 from clonos_tpu.parallel import transport as tp
-from clonos_tpu.parallel.routing import hash32_np
+from clonos_tpu.parallel.routing import hash32_np, subtask_for_key_group
 
 
 class QueryableStateEndpoint:
@@ -74,10 +74,12 @@ class QueryableStateEndpoint:
                           f"{key} out of range"})
         # Host-side (numpy) key->owner math: a server thread must never
         # dispatch device work (jax is main-thread-only on some
-        # backends; hash32_np is the exchange hash's host twin).
+        # backends; hash32_np is the exchange hash's host twin, and
+        # subtask_for_key_group is the SAME pure assignment the exchange
+        # compiles in).
         kg = int(hash32_np(np.asarray(key, np.int64))
                  % job.num_key_groups)
-        sub = (kg * p) // job.num_key_groups
+        sub = int(subtask_for_key_group(kg, p, job.num_key_groups))
         val = arr[sub, ..., key]
         return tp.QUERY_RESPONSE, tp.pack_json(
             {"value": np.asarray(val).tolist(), "subtask": sub,

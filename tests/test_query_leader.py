@@ -70,8 +70,12 @@ def test_leader_election_takeover_and_fencing(tmp_path):
     assert b.try_acquire() and b.epoch == 2
     assert b.leader() == "jm-b"
 
-    # The deposed leader cannot renew, and its stale token is rejected.
+    # The deposed leader cannot renew — and critically its renew can
+    # NEVER clobber the takeover: it rewrites only its own epoch's
+    # claim, which no reader looks at once a higher epoch exists (the
+    # split-brain race a shared lease file cannot avoid).
     assert not a.renew() and not a.is_leader()
+    assert b.leader() == "jm-b"
     assert not b.fencing_valid(1)
     assert b.fencing_valid(2)
 
@@ -81,9 +85,16 @@ def test_leader_election_takeover_and_fencing(tmp_path):
     assert not a.try_acquire()
     t[0] = 6.0
     assert a.try_acquire() and a.epoch == 3
+    # Superseded claims are garbage-collected (epochs < current-1).
+    assert a._claims() == [2, 3]
 
-    # The race arbiter: an epoch can be CLAIMED exactly once — two
-    # contenders racing on one expired lease can never both win the
-    # same fencing token (O_EXCL on the per-epoch claim file).
-    assert not b._claim(3)
-    assert b._claim(99) and not a._claim(99)
+    # The race arbiter: an epoch is claimable exactly once (O_EXCL), and
+    # a just-created still-empty claim counts as live (mid-write grace)
+    # so nobody steals an epoch whose owner is between create and write.
+    import os
+    fd = os.open(b._claim_path(9), os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+    os.close(fd)
+    assert not a.try_acquire() and not b.try_acquire()
+    with pytest.raises(FileExistsError):
+        os.close(os.open(b._claim_path(9),
+                         os.O_CREAT | os.O_EXCL | os.O_WRONLY))
