@@ -149,6 +149,31 @@ def cmd_worker(args) -> int:
     return 0
 
 
+def cmd_slotworker(args) -> int:
+    """Slot-pool TaskExecutor entrypoint (runtime/scheduler.py): the
+    process advertises slot capacity and runs ONLY the task slices the
+    JobMaster deploys onto it — a job spans several of these processes.
+    Job spec, runner settings, and recovery state all arrive inside the
+    fenced deployment descriptors; this process brings nothing but
+    slots. One JSON line per deployment and per (group, epoch)."""
+    from clonos_tpu.runtime.scheduler import SliceWorker
+
+    host, _, port = args.jm.partition(":")
+    worker = SliceWorker(
+        args.executor_id, (host, int(port)), lease_path=args.lease,
+        slots=args.slots, bind_host=args.bind_host,
+        heartbeat_interval=args.heartbeat_interval)
+    print(json.dumps({"registered": args.executor_id,
+                      "deploy_port": worker.endpoint.address[1],
+                      "slots": args.slots}), flush=True)
+    try:
+        worker.run(max_seconds=args.max_seconds,
+                   epoch_sleep=args.epoch_sleep)
+    finally:
+        worker.close()
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="clonos_tpu")
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -202,6 +227,24 @@ def main(argv=None) -> int:
     pw.add_argument("--num-processes", type=int, default=None)
     pw.add_argument("--process-id", type=int, default=None)
     pw.set_defaults(fn=cmd_worker)
+
+    ps = sub.add_parser("slotworker",
+                        help="serve task slots to a slot-pool JobMaster; "
+                             "runs only the task slices deployed onto it")
+    ps.add_argument("--jm", required=True, help="JobMaster host:port")
+    ps.add_argument("--executor-id", default="slotworker-0")
+    ps.add_argument("--slots", type=int, default=1)
+    ps.add_argument("--lease", default=None,
+                    help="shared leader-lease dir; DEPLOY fencing tokens "
+                         "are validated against its claims")
+    ps.add_argument("--bind-host", default="127.0.0.1")
+    ps.add_argument("--heartbeat-interval", type=float, default=0.5)
+    ps.add_argument("--max-seconds", type=float, default=600.0,
+                    help="wall guard: exit after this long")
+    ps.add_argument("--epoch-sleep", type=float, default=0.0,
+                    help="pause after each served epoch round (lets "
+                         "tests kill mid-run)")
+    ps.set_defaults(fn=cmd_slotworker)
 
     args = p.parse_args(argv)
     return args.fn(args)

@@ -89,3 +89,15 @@ def standby_device_order(mesh: jax.sharding.Mesh,
     n = mesh.shape[axis]
     per_host = max(1, n // max(jax.process_count(), 1))
     return [(i + per_host) % n for i in range(n)]
+
+
+def standby_worker_order(num_workers: int) -> Sequence[int]:
+    """Worker-process-level form of :func:`standby_device_order`, used by
+    the slot-pool scheduler's anti-affinity rule: task group ``i``'s
+    standby (the redeploy target when its primary worker dies) is the
+    NEXT worker in registration order — a vertex's standby never shares a
+    worker process with its primary, so one process loss cannot take
+    both (RunStandbyTaskStrategy.java:186 placement)."""
+    if num_workers < 1:
+        raise ValueError("standby_worker_order: need at least one worker")
+    return [(i + 1) % num_workers for i in range(num_workers)]

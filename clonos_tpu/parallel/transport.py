@@ -42,6 +42,14 @@ OK = 12
 ERROR = 13
 QUERY_STATE = 14           # external client -> queryable-state endpoint
 QUERY_RESPONSE = 15
+# scheduler / slot-pool surface (runtime/scheduler.py; reference
+# SlotPool.java offers + TaskExecutorGateway.submitTask + task state
+# reports)
+SLOT_OFFER = 16            # TaskExecutor -> JobMaster: add slot capacity
+DEPLOY = 17                # JobMaster -> TaskExecutor: fenced task slice
+TASK_STATE = 18            # TaskExecutor -> JobMaster: task transition
+FETCH_EDGE = 19            # downstream worker -> upstream edge export
+EDGE_DATA = 20             # payload = JSON header | int32 record rows
 
 
 def _send(sock: socket.socket, mtype: int, payload: bytes) -> None:

@@ -224,6 +224,11 @@ class ClusterRunner:
         self.heartbeats = HeartbeatMonitor(
             range(job.total_subtasks()), timeout_s=heartbeat_timeout_s)
         self.failed: Set[int] = set()
+        # Fence hooks run at every epoch fence BEFORE checkpoint
+        # completion truncates the logs and rings — the window where an
+        # edge export (runtime/scheduler.py) must snapshot the producer
+        # rings' fresh steps or lose them to the truncation.
+        self.fence_hooks: List = []
         self.global_step = 0
         self._fence_step: Dict[int, int] = {}   # epoch -> global step at start
         self._fence_step[0] = 0
@@ -669,6 +674,7 @@ class ClusterRunner:
     def bootstrap_standby(cls, job: JobGraph, checkpoint_dir: str,
                           mirror_rows: Dict[int, Tuple[np.ndarray, int]],
                           ignored_checkpoints: Sequence[int] = (),
+                          feed_readers: Optional[Dict[int, object]] = None,
                           **runner_kw
                           ) -> Tuple["ClusterRunner", RecoveryReport]:
         """Standby-HOST failover: rebuild the ENTIRE job in a fresh
@@ -689,7 +695,14 @@ class ClusterRunner:
         Requirements: ``mirror_rows`` must cover every flat subtask and
         end at an epoch fence (mirrors refresh at fences); rebalance
         edges are not yet reconstructible (their round-robin cursors are
-        not in the lean snapshot's fence state)."""
+        not in the lean snapshot's fence state).
+
+        ``feed_readers`` maps HostFeedSource vertex ids to rewindable
+        readers (api/feeds.py contract); they are registered BEFORE the
+        replay so the feed re-read path (`_reread_feed`) can serve the
+        recorded offset windows — required when the rebuilt job has
+        host-boundary sources (e.g. a scheduler slice whose cut in-edges
+        arrive over the wire)."""
         for e in job.edges:
             if e.partition == PartitionType.REBALANCE:
                 raise rec.RecoveryError(
@@ -697,6 +710,8 @@ class ClusterRunner:
                     "(post-replay round-robin cursors are not "
                     "reconstructible from the fence snapshot)")
         runner = cls(job, checkpoint_dir=checkpoint_dir, **runner_kw)
+        for vid, reader in (feed_readers or {}).items():
+            runner.executor.register_feed(vid, reader)
         storage = runner.coordinator.storage
         ignored = set(ignored_checkpoints)
         # Only fully-ACKED checkpoints are restore points; triggered-but-
@@ -719,9 +734,21 @@ class ClusterRunner:
                 f"{missing}")
 
         # The absolute superstep at the fence: the lean snapshot's ring
-        # heads ARE step counts (one append per superstep).
-        fence = (int(np.asarray(ckpt.carry.ring_heads[0]))
-                 if ckpt.carry.ring_heads else 0)
+        # heads ARE step counts (one append per superstep). A job with
+        # no rings (single vertex, no edges) carries no such counter —
+        # silently fencing at step 0 would rebase a mid-run checkpoint
+        # to the beginning of time and replay from the wrong offset, so
+        # refuse anything past epoch 0 instead.
+        if ckpt.carry.ring_heads:
+            fence = int(np.asarray(ckpt.carry.ring_heads[0]))
+        elif ckpt.checkpoint_id > 0:
+            raise rec.RecoveryError(
+                f"bootstrap_standby: checkpoint {ckpt.checkpoint_id} has "
+                f"no in-flight ring heads to derive the fence step from "
+                f"(edge-less job past epoch 0) — the fence cannot be "
+                f"reconstructed")
+        else:
+            fence = spe
 
         # Steps replayed = sync-anchor count of the mirrored streams
         # (lockstep supersteps: every log advances together, and the
@@ -846,6 +873,27 @@ class ClusterRunner:
                 bufs[eidx] = jax.tree_util.tree_map(
                     lambda x: x[0], routed)
             runner.executor.carry = c._replace(edge_bufs=tuple(bufs))
+        else:
+            # Nothing replayed: the completed fence IS the rebuild point,
+            # and the lean snapshot's depth-1 edge buffers (produced at
+            # step fence-1, consumed by the next live step) are the only
+            # copy of that in-flight batch — the rings below the fence
+            # were truncated on completion and are not rebuilt.
+            c = runner.executor.carry
+            bufs = jax.tree_util.tree_map(
+                lambda x: jnp.asarray(x).copy(), ckpt.carry.edge_bufs)
+            runner.executor.carry = c._replace(edge_bufs=tuple(bufs))
+
+        # The host RNG is a seeded per-run stream, one draw per executed
+        # superstep; replay reproduced the prefix from RECORDED rng
+        # determinants without consuming it. Fast-forward a fresh stream
+        # past the prefix (the exact per-step draw call, so stream
+        # consumption matches) — the continuation then draws precisely
+        # what the never-failed run would have drawn at these steps.
+        ex = runner.executor
+        ex._rng = np.random.RandomState(ex._seed)
+        for _ in range(fence + n_steps):
+            ex._rng.randint(0, 2 ** 31, dtype=np.int64)
         return runner, report
 
     @classmethod
@@ -929,16 +977,24 @@ class ClusterRunner:
             op_states=tuple(ops), edge_bufs=tuple(bufs))
         return runner
 
-    def attach_file_sink(self, vertex_id: int, root: str):
+    def attach_file_sink(self, vertex_id: int, root: str, election=None):
         """Back a transactional sink with durable part files
         (runtime/filesink.py — the StreamingFileSink analog): pendings
         persist at every epoch seal, commits are atomic renames, and
-        stale pendings of a dead incarnation are swept now."""
+        stale pendings of a dead incarnation are swept now.
+
+        ``election`` (a ``runtime.leader.FileLeaderElection`` or any
+        object with ``is_leader()``) fences every mutating sink
+        operation on leadership: when two incarnations share ``root``
+        (the standby-takeover deployment this sink exists for), a
+        fenced-off incarnation attaching here must NOT run the startup
+        sweep — it would delete the healthy writer's in-progress
+        pendings."""
         from clonos_tpu.runtime.filesink import FileSystemSink
         if vertex_id not in self.txn_logs:
             raise ValueError(
                 f"vertex {vertex_id} is not a transactional sink")
-        fs = FileSystemSink(root)
+        fs = FileSystemSink(root, fencing=election)
         tl = self.txn_logs[vertex_id]
         tl.pre_committer = fs.write_pending
         tl.committer = fs.commit
@@ -1042,6 +1098,10 @@ class ClusterRunner:
                     checkpoint_id=closed, timestamp=t_ms))
         for tl in self.txn_logs.values():
             tl.seal(closed)
+        # Before completion: ack_all truncates rings up to this fence,
+        # so anything reading their fresh steps (edge exports) goes now.
+        for hook in self.fence_hooks:
+            hook(closed)
         if complete_checkpoint:
             self.coordinator.ack_all(closed)
 

@@ -523,6 +523,26 @@ class CausalTimeSource:
         return int((_time.monotonic() - self._t0) * 1000) & 0x7FFFFFFF
 
 
+class LogicalTimeSource:
+    """Deterministic causal time: 1 ms per superstep, read as the
+    absolute step index about to be stamped. Wall-clock TIMESTAMP
+    determinants are the one live-path input that replay reproduces but
+    two INDEPENDENT runs never share; with logical time the whole step
+    input stream is a pure function of (job, seed, feed records), so a
+    spanned job's slices can be digest-compared against a no-failure
+    control run. After a standby rebuild the restored
+    ``step_input_history`` makes the clock resume exactly at the fence
+    step — bit-identical with the run that never failed."""
+
+    def __init__(self, executor: "LocalExecutor"):
+        self._ex = executor
+
+    def now(self) -> int:
+        # Called exactly once per superstep, just before the (t, rng)
+        # append — history length IS the global index of that step.
+        return len(self._ex.step_input_history) & 0x7FFFFFFF
+
+
 class LocalExecutor:
     """Single-process job driver (MiniCluster analog): owns the compiled
     job, the carry, the causal time/RNG sources, and the epoch loop."""
@@ -535,7 +555,7 @@ class LocalExecutor:
                  spill_policy: str = ifl.SpillPolicy.EAGER,
                  block_steps: Optional[int] = None,
                  replication_factor: int = -1,
-                 seed: int = 0):
+                 seed: int = 0, logical_time: bool = False):
         self.compiled = CompiledJob(job, log_capacity=log_capacity,
                                     max_epochs=max_epochs,
                                     inflight_ring_steps=inflight_ring_steps,
@@ -546,7 +566,9 @@ class LocalExecutor:
         self.block_steps = min(block_steps or 512, steps_per_epoch,
                                inflight_ring_steps)
         self.carry = self.compiled.init_carry()
-        self.time_source = CausalTimeSource()
+        self.time_source = (LogicalTimeSource(self) if logical_time
+                            else CausalTimeSource())
+        self._seed = seed
         self._rng = np.random.RandomState(seed)
         self.epoch_id = 0
         self.step_in_epoch = 0

@@ -29,11 +29,29 @@ import numpy as np
 
 
 class FileSystemSink:
-    """One sink vertex's durable part-file store."""
+    """One sink vertex's durable part-file store.
 
-    def __init__(self, root: str):
+    ``fencing`` (optional) is a leadership handle exposing
+    ``is_leader()`` — typically a ``runtime.leader.FileLeaderElection``.
+    When set, every mutating operation (pending write, commit rename,
+    and above all the destructive :meth:`sweep_pending`) refuses to run
+    unless this incarnation currently holds the lease: two incarnations
+    sharing the sink root is exactly the standby-takeover scenario, and
+    the deposed one sweeping on startup would delete the healthy
+    writer's in-progress pendings."""
+
+    def __init__(self, root: str, fencing=None):
         self.root = root
+        self.fencing = fencing
         os.makedirs(root, exist_ok=True)
+
+    def _check_fencing(self, what: str) -> None:
+        if self.fencing is not None and not self.fencing.is_leader():
+            raise PermissionError(
+                f"filesink {what} refused: this incarnation does not hold "
+                f"the leadership lease for {self.root!r} — a fenced-off "
+                f"writer must not mutate a sink root another incarnation "
+                f"may be writing")
 
     def _part(self, epoch: int, sub: int, state: str) -> str:
         return os.path.join(self.root, f"part-{epoch}-{sub}.{state}")
@@ -45,6 +63,7 @@ class FileSystemSink:
         """Pre-commit: persist every subtask shard of the sealed epoch
         (atomic per-file: temp + replace, so a crash mid-write never
         leaves a torn pending)."""
+        self._check_fencing("write_pending")
         for sub, rows in shards.items():
             path = self._part(epoch, sub, "pending")
             tmp = path + ".tmp"
@@ -55,6 +74,7 @@ class FileSystemSink:
     def commit(self, epoch: int, _rows: np.ndarray) -> None:
         """Checkpoint complete: pendings of ``epoch`` become final,
         atomically, subtask-major."""
+        self._check_fencing("commit")
         for fn in sorted(os.listdir(self.root)):
             if fn.startswith(f"part-{epoch}-") and fn.endswith(".pending"):
                 src = os.path.join(self.root, fn)
@@ -66,6 +86,7 @@ class FileSystemSink:
         """Startup recovery: delete pendings whose epoch is not in
         ``keep_epochs`` (their checkpoint will never complete — the
         recoverAndAbort pass). Returns the removed filenames."""
+        self._check_fencing("sweep_pending")
         keep = set(keep_epochs)
         removed = []
         for fn in sorted(os.listdir(self.root)):

@@ -15,7 +15,8 @@ impossible rather than racily unlikely:
 - every fencing epoch is one file, ``<path>.epoch<N>.claim``, created
   with O_CREAT|O_EXCL — the filesystem arbitrates, so an epoch has
   exactly one owner, ever;
-- the claim file IS the lease: its content ``{leader_id, deadline}`` is
+- the claim file IS the lease: its content ``{leader_id, deadline_wall}``
+  (a wall-clock deadline — comparable across hosts and boots) is
   rewritten (atomic tmp+replace) only by its owner on renewal — there is
   no shared lease file two writers could race on, which is exactly the
   TOCTOU a central lease record cannot avoid;
@@ -39,11 +40,16 @@ class FileLeaderElection:
 
     def __init__(self, path: str, contender_id: str,
                  lease_ttl_s: float = 2.0,
-                 clock=time.monotonic):
+                 clock=None):
         self.path = path
         self.contender_id = contender_id
         self.ttl = lease_ttl_s
-        self._clock = clock
+        #: Lease deadlines are WALL-CLOCK (`time.time`) because claim
+        #: files are shared-filesystem state read by contenders on OTHER
+        #: hosts, across process (and host) restarts — CLOCK_MONOTONIC is
+        #: per-boot and means nothing to another reader. The injected
+        #: clock exists for tests only.
+        self._clock = time.time if clock is None else clock
         #: fencing token of OUR current leadership (None = not leader)
         self.epoch: Optional[int] = None
 
@@ -90,7 +96,7 @@ class FileLeaderElection:
         tmp = f"{self._claim_path(epoch)}.{self.contender_id}.tmp"
         with open(tmp, "w") as f:
             json.dump({"leader_id": self.contender_id,
-                       "deadline": deadline}, f)
+                       "deadline_wall": deadline}, f)
         os.replace(tmp, self._claim_path(epoch))
 
     def _current(self) -> Optional[dict]:
@@ -103,7 +109,7 @@ class FileLeaderElection:
             # Grace keyed to wall time (mtime); the injected clock does
             # not apply to a foreign writer mid-create.
             return time.time() > rec["deadline_wall"]
-        return self._clock() > rec["deadline"]
+        return self._clock() > rec["deadline_wall"]
 
     # --- contender API -------------------------------------------------------
 
@@ -162,7 +168,9 @@ class FileLeaderElection:
 
     def fencing_valid(self, epoch: int) -> bool:
         """Would an action stamped with ``epoch`` be accepted now? (The
-        receiver-side check: reject anything below the highest claimed
-        epoch — a deposed leader's late RPCs.)"""
+        receiver-side check.) Valid tokens are exactly the HIGHEST
+        EXISTING claim: anything below it is a deposed leader's late RPC,
+        and anything above it is a forged token for an epoch nobody has
+        won through O_EXCL arbitration — both are rejected."""
         claims = self._claims()
-        return bool(claims) and epoch >= claims[-1]
+        return bool(claims) and epoch == claims[-1]

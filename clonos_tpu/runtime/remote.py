@@ -40,7 +40,17 @@ from clonos_tpu.parallel import transport as tp
 class JobMasterServer:
     """Minimal dispatcher/JobMaster endpoint: executors register, then
     heartbeat against a deadline; expiry marks them failed (the trigger
-    for standby failover on the control plane)."""
+    for standby failover on the control plane).
+
+    Scheduling surface (the SlotPool feed — reference
+    jobmaster/slotpool/SlotPool.java offer path +
+    TaskExecutorGateway.java state reports): registration carries a
+    ``slots`` advertisement (how many task slices the worker will host),
+    SLOT_OFFER adds capacity later, and TASK_STATE records per-deployed-
+    task transitions (``DEPLOYING``/``RUNNING``/``FINISHED``/…) keyed by
+    ``(executor_id, group)`` together with the ports the task opened
+    (determinant-log endpoint, edge exports) — the JobMaster-side
+    scheduler reads both through :meth:`slots` / :meth:`task_state`."""
 
     def __init__(self, heartbeat_timeout_s: float = 5.0,
                  host: str = "127.0.0.1", port: int = 0):
@@ -48,6 +58,8 @@ class JobMasterServer:
         self._last: Dict[str, float] = {}
         self._meta: Dict[str, dict] = {}
         self._ignored: List[int] = []
+        self._slots: Dict[str, int] = {}
+        self._tasks: Dict[Tuple[str, int], dict] = {}
         self._lock = threading.Lock()
         self.server = tp.ControlServer(self._handle, host, port)
         self.address = self.server.address
@@ -58,6 +70,7 @@ class JobMasterServer:
             with self._lock:
                 self._meta[info["executor_id"]] = info
                 self._last[info["executor_id"]] = time.monotonic()
+                self._slots[info["executor_id"]] = int(info.get("slots", 0))
             return tp.OK, tp.pack_json({"registered": True})
         if mtype == tp.HEARTBEAT:
             info = tp.unpack_json(payload)
@@ -69,11 +82,41 @@ class JobMasterServer:
             with self._lock:
                 self._ignored.append(info["checkpoint_id"])
             return tp.OK, b""
+        if mtype == tp.SLOT_OFFER:
+            info = tp.unpack_json(payload)
+            eid = info["executor_id"]
+            with self._lock:
+                self._slots[eid] = self._slots.get(eid, 0) \
+                    + int(info["slots"])
+            return tp.OK, tp.pack_json({"slots": self._slots[eid]})
+        if mtype == tp.TASK_STATE:
+            info = tp.unpack_json(payload)
+            with self._lock:
+                self._tasks[(info["executor_id"], int(info["group"]))] = info
+            return tp.OK, b""
         return tp.ERROR, tp.pack_json({"error": f"bad mtype {mtype}"})
 
     def registered(self) -> List[str]:
         with self._lock:
             return sorted(self._meta)
+
+    def slots(self) -> Dict[str, int]:
+        """Advertised slot capacity per registered executor."""
+        with self._lock:
+            return dict(self._slots)
+
+    def info(self, executor_id: str) -> dict:
+        """The registration record for ``executor_id`` (deploy endpoint,
+        slot count, …) — what the scheduler dials to submit tasks."""
+        with self._lock:
+            if executor_id not in self._meta:
+                raise KeyError(f"executor {executor_id!r} never registered")
+            return dict(self._meta[executor_id])
+
+    def task_state(self, executor_id: str, group: int) -> Optional[dict]:
+        """Latest TASK_STATE report for ``(executor_id, group)``."""
+        with self._lock:
+            return self._tasks.get((executor_id, group))
 
     def expired(self) -> List[str]:
         now = time.monotonic()
@@ -353,7 +396,8 @@ class RemoteReplicaMirror:
 
     def rows(self, flat: int) -> np.ndarray:
         log = self._replicas[flat]
-        return log.delta_for_consumer(log.tail, log.head - log.tail)[0]
+        return log.delta_for_consumer(
+            log.tail, max(0, log.head - log.tail))[0]
 
     def rows_with_start(self, flat: int) -> Tuple[np.ndarray, int]:
         """(live rows, absolute offset of rows[0]) — the determinant-
@@ -432,8 +476,16 @@ class RemoteReplicaMirror:
         for flat, log in self._replicas.items():
             floor = int(floors.get(str(flat), log.tail))
             if floor > log.tail:
+                # The floor can sit PAST our merged head: the owner
+                # truncated its whole log across a completed checkpoint
+                # before we absorbed those rows, so this round served no
+                # delta at all. Rows below a completed-checkpoint floor
+                # are never a restore input — rebase to an EMPTY window
+                # at the floor instead of leaving tail > head (a
+                # negative live window that corrupts later slices).
                 log.state = log.state._replace(
-                    tail=jnp.asarray(floor, jnp.int32))
+                    tail=jnp.asarray(floor, jnp.int32),
+                    head=jnp.asarray(max(floor, int(log.head)), jnp.int32))
             if int(log.head) - int(log.tail) > log.capacity:
                 raise RuntimeError(
                     f"mirror of log {flat}: {int(log.head) - int(log.tail)}"

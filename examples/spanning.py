@@ -1,0 +1,43 @@
+"""Spanning-job demo: one job deployed across SEVERAL worker processes.
+
+The SocketWindowWordCount shape (examples/wordcount.py) arranged for the
+slot-pool scheduler (runtime/scheduler.py): a socket-fed source slice and
+a keyed-window slice, cut on the HASH exchange between them — the shape
+the reference deploys across TaskManagers (one TaskDeploymentDescriptor
+per slot, SlotPool.java allocation). With two slot workers the scheduler
+places ``[lines, tag]`` on one process and ``[window, sink]`` on the
+other; records cross between them over the edge-export wire.
+
+Run (three terminals; the feed is any line server on :9999, e.g. ``nc``):
+    python -m clonos_tpu slotworker --jm HOST:PORT --executor-id a
+    python -m clonos_tpu slotworker --jm HOST:PORT --executor-id b
+    # then drive SlotPoolScheduler.deploy() against the same JobMaster
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+from clonos_tpu.api.environment import StreamEnvironment
+
+VOCAB = 256
+WINDOW_MS = 500
+BATCH = 8
+
+
+def build_job():
+    """lines -> tag -> (HASH) -> window -> sink.
+
+    ``lines`` is a HostFeedSource at parallelism 1 (externally fed — a
+    SocketFeedReader in the distributed tests); ``tag`` rides the same
+    slice on a FORWARD edge; the key_by HASH exchange is the only legal
+    slice boundary, so two workers always split exactly there."""
+    env = StreamEnvironment(name="spanning-wordcount", num_key_groups=64)
+    (env.host_source(batch_size=BATCH, parallelism=1, name="lines")
+        .map(lambda k, v, t: (k % VOCAB, v, t), name="tag")
+        .key_by()
+        .window_count(num_keys=VOCAB, window_size=WINDOW_MS, name="window")
+        .sink(name="sink"))
+    return env.build()

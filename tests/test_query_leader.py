@@ -78,6 +78,14 @@ def test_leader_election_takeover_and_fencing(tmp_path):
     assert b.leader() == "jm-b"
     assert not b.fencing_valid(1)
     assert b.fencing_valid(2)
+    # A forged token for an epoch nobody won through O_EXCL arbitration
+    # is rejected too: valid tokens are EXACTLY the highest claim.
+    assert not b.fencing_valid(3)
+    # Claims carry wall-clock deadlines — comparable across hosts/boots.
+    import json
+    with open(b._claim_path(2)) as f:
+        rec = json.load(f)
+    assert rec["leader_id"] == "jm-b" and "deadline_wall" in rec
 
     # Re-acquire by the old leader only after the new lease lapses,
     # with a fresh higher epoch.
@@ -98,3 +106,39 @@ def test_leader_election_takeover_and_fencing(tmp_path):
     with pytest.raises(FileExistsError):
         os.close(os.open(b._claim_path(9),
                          os.O_CREAT | os.O_EXCL | os.O_WRONLY))
+
+
+def test_file_sink_gated_on_leadership_fencing(tmp_path):
+    """A FileSystemSink owned by a deposed JobMaster incarnation must not
+    write, commit, or sweep: a stale leader sweeping pending files would
+    destroy the NEW leader's in-flight transactions. The sink checks its
+    election handle at every mutation."""
+    from clonos_tpu.runtime.filesink import FileSystemSink
+
+    path = str(tmp_path / "jm.lease")
+    t = [0.0]
+    a = FileLeaderElection(path, "jm-a", lease_ttl_s=2.0,
+                           clock=lambda: t[0])
+    assert a.try_acquire()
+    rows = np.asarray([[1, 2, 3]], np.int32)
+    sink = FileSystemSink(str(tmp_path / "out"), fencing=a)
+    sink.write_pending(1, {0: rows})          # leader: allowed
+    sink.commit(1, rows)
+    assert sink.sweep_pending() == []
+
+    # Depose jm-a; its sink handle must refuse every mutation.
+    t[0] = 3.5
+    b = FileLeaderElection(path, "jm-b", lease_ttl_s=2.0,
+                           clock=lambda: t[0])
+    assert b.try_acquire()
+    assert not a.renew()
+    for op in (lambda: sink.write_pending(2, {0: rows}),
+               lambda: sink.commit(2, rows),
+               lambda: sink.sweep_pending()):
+        with pytest.raises(PermissionError):
+            op()
+    # The new incarnation's sink over the same root works.
+    sink_b = FileSystemSink(str(tmp_path / "out"), fencing=b)
+    sink_b.write_pending(2, {0: rows})
+    sink_b.commit(2, rows)
+    assert sink_b.committed_epochs() == [1, 2]

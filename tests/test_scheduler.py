@@ -1,0 +1,489 @@
+"""Slot-pool scheduler: one job spanning multiple worker processes, with
+fenced per-task recovery (runtime/scheduler.py; reference
+jobmaster/slotpool/SlotPool.java offers/allocation,
+TaskExecutorGateway.submitTask + TaskDeploymentDescriptor, the JobMaster
+fencing token on every RPC, and RunStandbyTaskStrategy placement).
+
+The headline test drives a REAL spanned job: two slot-worker OS
+processes each run only their slice of the graph (records cross between
+them over the edge-export wire, the upstream slice fed by a
+SocketFeedReader), one worker is SIGKILLed, and the scheduler redeploys
+ONLY its task group onto the survivor — causal replay bit-identical to
+the dead worker's last mirrored fence AND to a no-failure control run
+over the same record stream; a stale fencing token's DEPLOY is rejected.
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from clonos_tpu.graph.job_graph import PartitionType
+from clonos_tpu.parallel import transport as tp
+from clonos_tpu.parallel.distributed import standby_worker_order
+from clonos_tpu.runtime import scheduler as sch
+from clonos_tpu.runtime.leader import FileLeaderElection
+from clonos_tpu.runtime.remote import JobMasterServer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _spanning_job():
+    import examples.spanning as sp
+    return sp.build_job()
+
+
+def _wordcount_job():
+    import examples.wordcount as wc
+    return wc.build_job()
+
+
+# --- placement ---------------------------------------------------------------
+
+
+def test_partition_vertices_cuts_on_exchange_edges():
+    job = _spanning_job()          # lines -> tag -> (HASH) window -> sink
+    parts = sch.partition_vertices(job, 2)
+    assert parts == [[0, 1], [2, 3]]
+    # Every crossing edge of every cut is an exchange edge.
+    for part in parts:
+        ins, outs = sch.cut_edges(job, part)
+        for eidx in ins + outs:
+            assert job.edges[eidx].partition != PartitionType.FORWARD
+    assert sch.partition_vertices(job, 1) == [[0, 1, 2, 3]]
+
+    wc = _wordcount_job()          # source -(HASH)- window -(FWD)- sink
+    assert sch.partition_vertices(wc, 2) == [[0], [1, 2]]
+    with pytest.raises(ValueError, match="cut points"):
+        sch.partition_vertices(wc, 3)    # only one exchange cut exists
+    with pytest.raises(ValueError, match="cannot cut"):
+        sch.partition_vertices(wc, 0)
+    with pytest.raises(ValueError, match="cannot cut"):
+        sch.partition_vertices(wc, 9)
+
+
+def test_subgraph_boundaries_feeds_exports_and_forward_rejection():
+    job = _spanning_job()
+    sub0, vmap0, feeds0, exports0 = job.subgraph([0, 1], feed_batch_size=4)
+    assert vmap0 == {0: 0, 1: 1} and feeds0 == {}
+    assert exports0 == {1: 1}            # cut out-edge 1 served by "tag"
+    assert [v.name for v in sub0.vertices] == ["lines", "tag", "export-1"]
+    # The export consumer rides a FORWARD edge (keeps tag's ring local).
+    assert sub0.edges[-1].partition == PartitionType.FORWARD
+
+    sub1, vmap1, feeds1, exports1 = job.subgraph([2, 3], feed_batch_size=4)
+    assert vmap1 == {2: 0, 3: 1} and exports1 == {}
+    assert feeds1 == {1: 2}              # cut in-edge 1 -> boundary feed
+    fv = sub1.vertices[feeds1[1]]
+    assert fv.name == "feed-in-1" and fv.parallelism == 1
+    assert fv.operator.batch_size == 4
+    # The boundary feed drives window through the ORIGINAL HASH exchange.
+    (feed_edge,) = [e for e in sub1.edges if e.src == feeds1[1]]
+    assert feed_edge.partition == PartitionType.HASH
+    assert feed_edge.dst == vmap1[2]
+
+    # A cut across a FORWARD edge cannot be served by the flattened wire
+    # export — rejected loudly (window -> sink in wordcount is FORWARD).
+    with pytest.raises(ValueError, match="FORWARD"):
+        _wordcount_job().subgraph([2])
+
+
+def test_slot_pool_allocation_standby_and_worker_loss():
+    pool = sch.SlotPool()
+    pool.sync_offers({"a": 2, "b": 1})
+    assert pool.workers() == ["a", "b"]
+    assert len(pool.free_slots()) == 3
+
+    s0 = pool.allocate(0, prefer="a")
+    s1 = pool.allocate(1, prefer="b")
+    assert (s0.worker_id, s1.worker_id) == ("a", "b")
+    assert pool.placements() == {0: "a", 1: "b"}
+    # Anti-affinity: avoid excludes a worker even when preferred.
+    s2 = pool.allocate(2, prefer="b", avoid=("b",))
+    assert s2.worker_id == "a"
+    with pytest.raises(RuntimeError, match="no free slot"):
+        pool.allocate(3, avoid=("a", "b"))
+
+    # Worker death strands its groups for redeployment.
+    assert pool.drop_worker("b") == [1]
+    assert pool.workers() == ["a"]
+    pool.release_group(2)
+    assert pool.allocate(1).worker_id == "a"
+
+    # Rotate-by-one standby order: a group's standby never shares its
+    # primary's process.
+    assert list(standby_worker_order(3)) == [1, 2, 0]
+    assert list(standby_worker_order(1)) == [0]
+    with pytest.raises(ValueError):
+        standby_worker_order(0)
+
+
+# --- fenced deployment gateway ----------------------------------------------
+
+
+def _deploy_frame(tdd, frame=b""):
+    hdr = tp.pack_json(tdd)
+    return len(hdr).to_bytes(4, "little") + hdr + frame
+
+
+def test_endpoint_rejects_stale_and_forged_fencing_tokens(tmp_path):
+    lease = str(tmp_path / "jm.lease")
+    t = [0.0]
+    a = FileLeaderElection(lease, "jm-a", lease_ttl_s=2.0,
+                           clock=lambda: t[0])
+    b = FileLeaderElection(lease, "jm-b", lease_ttl_s=2.0,
+                           clock=lambda: t[0])
+    assert a.try_acquire() and a.epoch == 1
+    t[0] = 3.5                            # jm-a's lease lapses
+    assert b.try_acquire() and b.epoch == 2
+
+    ep = sch.TaskExecutorEndpoint(lease_path=lease)
+    cl = tp.ControlClient(ep.address)
+    try:
+        # No token at all -> rejected.
+        rt, resp = cl.call(tp.DEPLOY, _deploy_frame({"group": 0}))
+        assert rt == tp.ERROR and "no fencing" in tp.unpack_json(resp)["error"]
+        # The deposed leader's token (below the highest claim) -> rejected.
+        rt, resp = cl.call(tp.DEPLOY,
+                           _deploy_frame({"group": 0, "fencing_epoch": 1}))
+        assert rt == tp.ERROR
+        assert "lease claim" in tp.unpack_json(resp)["error"]
+        # A forged token above every real claim -> rejected.
+        rt, resp = cl.call(tp.DEPLOY,
+                           _deploy_frame({"group": 0, "fencing_epoch": 9}))
+        assert rt == tp.ERROR
+        # The live leader's token -> accepted and queued.
+        rt, resp = cl.call(tp.DEPLOY,
+                           _deploy_frame({"group": 7, "fencing_epoch": 2}))
+        assert rt == tp.OK and tp.unpack_json(resp)["accepted"]
+        assert ep.queue.get_nowait()["group"] == 7
+        assert ep.queue.empty()
+    finally:
+        cl.close()
+        ep.close()
+
+    # Without a lease dir the gate still enforces monotone tokens: once
+    # an epoch was accepted, anything below it is a deposed JobMaster.
+    ep2 = sch.TaskExecutorEndpoint()
+    cl2 = tp.ControlClient(ep2.address)
+    try:
+        rt, _ = cl2.call(tp.DEPLOY,
+                         _deploy_frame({"group": 0, "fencing_epoch": 5}))
+        assert rt == tp.OK
+        rt, resp = cl2.call(tp.DEPLOY,
+                            _deploy_frame({"group": 0, "fencing_epoch": 4}))
+        assert rt == tp.ERROR
+        assert "stale fencing" in tp.unpack_json(resp)["error"]
+    finally:
+        cl2.close()
+        ep2.close()
+
+
+# --- ring-less bootstrap fence (satellite) -----------------------------------
+
+
+def test_bootstrap_standby_refuses_ringless_fence_past_epoch_zero(tmp_path):
+    """An edge-less job's lean snapshot carries no ring heads, so the
+    absolute fence step of a checkpoint past epoch 0 cannot be derived;
+    silently fencing at 0 would replay from the wrong offsets — the
+    rebuild must refuse loudly instead (RecoveryError)."""
+    from clonos_tpu.api.environment import StreamEnvironment
+    from clonos_tpu.causal.recovery import RecoveryError
+    from clonos_tpu.runtime.cluster import ClusterRunner
+
+    env = StreamEnvironment(name="ringless", num_key_groups=8)
+    env.synthetic_source(vocab=7, batch_size=4, parallelism=1)
+    job = env.build()
+    ck = str(tmp_path / "ck")
+    r = ClusterRunner(job, steps_per_epoch=4, checkpoint_dir=ck,
+                      log_capacity=256, max_epochs=8, seed=2)
+    for _ in range(3):
+        r.run_epoch(complete_checkpoint=True)
+    logs = r.executor.carry.logs
+    head = int(np.asarray(logs.head)[0])
+    tail = int(np.asarray(logs.tail)[0])
+    cap = np.asarray(logs.rows).shape[1]
+    pos = np.arange(tail, head) & (cap - 1)
+    mirror_rows = {0: (np.asarray(logs.rows)[0][pos], tail)}
+    with pytest.raises(RecoveryError, match="no in-flight ring heads"):
+        ClusterRunner.bootstrap_standby(job, ck, mirror_rows,
+                                        steps_per_epoch=4, log_capacity=256,
+                                        max_epochs=8, seed=2)
+
+
+# --- cross-worker edge wire, in-process --------------------------------------
+
+
+def _epochs(runner, n, complete_every=2):
+    out = {}
+    for _ in range(n):
+        closed = runner.executor.epoch_id
+        runner.run_epoch(complete_checkpoint=(closed % complete_every == 0))
+        out[runner.global_step] = runner.state_digest()
+    return out
+
+
+def test_edge_export_wire_is_deterministic_and_rewindable():
+    """The downstream half of a cut edge consumed over the WIRE
+    (EdgeExportServer -> RemoteEdgeFeedReader, blocking exact-count
+    pulls) produces bit-identical digests to the same slice fed the same
+    records from memory — per-step batch boundaries must not depend on
+    transport timing. Also pins read_at (the replay path) and the
+    loud-failure contract past a finished stream."""
+    from clonos_tpu.api.feeds import ListFeedReader
+    from clonos_tpu.runtime.cluster import ClusterRunner
+
+    job = _spanning_job()
+    # logical_time: wall-clock TIMESTAMP determinants are the one step
+    # input two independent runs never share — with the logical clock,
+    # digests are a pure function of (job, seed, records).
+    kw = dict(steps_per_epoch=4, log_capacity=512, max_epochs=16,
+              inflight_ring_steps=32, seed=7, logical_time=True)
+    lines = [((i * 37) % 997, 1 + i % 5) for i in range(128)]
+
+    sub0, vmap0, _f, exports0 = job.subgraph([0, 1], feed_batch_size=8)
+    up = ClusterRunner(sub0, **kw)
+    up.executor.register_feed(vmap0[0], ListFeedReader([lines]))
+    export = sch.EdgeExportServer(up, exports0)   # hooks the fence
+    try:
+        # 4 epochs drain the feed + 1 flush epoch: the source->tag hop is
+        # one superstep deep, so the last batch reaches the export ring
+        # only after the feed is already exhausted.
+        _epochs(up, 5)
+        export.mark_final()
+
+        sub1, _v, feeds1, _e = job.subgraph([2, 3], feed_batch_size=8)
+        down = ClusterRunner(sub1, **kw)
+        reader = sch.RemoteEdgeFeedReader(export.address, edge=1)
+        down.executor.register_feed(feeds1[1], reader)
+        wire_digests = _epochs(down, 4)
+
+        # read_at re-serves exact absolute ranges (causal replay path).
+        k0, v0 = reader.read_at(0, 0, 16)
+        k1, v1 = reader.read_at(0, 8, 8)
+        assert k0[8:] == k1 and v0[8:] == v1
+        # Reading past a FINISHED stream fails loudly, never hangs.
+        with pytest.raises(RuntimeError, match="finished"):
+            reader.read_at(0, 0, 10_000)
+
+        # Control: the same slice over the same records from memory.
+        cl = tp.ControlClient(export.address)
+        rt, resp = cl.call(tp.FETCH_EDGE, tp.pack_json(
+            {"edge": 1, "start": 0, "count": 1 << 20}))
+        assert rt == tp.EDGE_DATA
+        hlen = int.from_bytes(resp[:4], "little")
+        hdr = tp.unpack_json(resp[4: 4 + hlen])
+        assert hdr["final"] and hdr["count"] == hdr["avail"] == 128
+        recs = np.frombuffer(resp[4 + hlen:], np.int32).reshape(-1, 2)
+        cl.close()
+
+        ctrl = ClusterRunner(sub1, **kw)
+        ctrl.executor.register_feed(feeds1[1],
+                                    ListFeedReader([recs.tolist()]))
+        ctrl_digests = _epochs(ctrl, 4)
+        assert wire_digests == ctrl_digests
+        reader.close()
+    finally:
+        export.close()
+
+
+# --- THE spanned job: 2 worker processes, SIGKILL, fenced recovery ----------
+
+
+def _line_server(lines):
+    """Minimal TCP line feed: accepts one client, sends every line
+    immediately, keeps the connection open."""
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(2)
+    conns = []
+
+    def serve():
+        try:
+            while True:
+                conn, _ = srv.accept()
+                conns.append(conn)
+                conn.sendall("".join(f"{k}:{v}\n"
+                                     for k, v in lines).encode())
+        except OSError:
+            return
+
+    threading.Thread(target=serve, daemon=True).start()
+    return srv, srv.getsockname()[1], conns
+
+
+def _read_status(proc, want, deadline_s=300.0):
+    """Read JSON lines from a worker's stdout until ``want(st)``; returns
+    (matching record, all digest-bearing group records seen)."""
+    seen = {}
+    deadline = time.monotonic() + deadline_s
+    for line in iter(proc.stdout.readline, ""):
+        assert time.monotonic() < deadline, "worker status timeout"
+        st = json.loads(line)
+        if "group" in st and "digest" in st:
+            seen[st["global_step"]] = st["digest"]
+        if want(st):
+            return st, seen
+    raise AssertionError("worker stdout closed before expected status")
+
+
+def test_job_spans_two_workers_with_fenced_per_task_recovery(tmp_path):
+    """Acceptance: vertices of ONE job deployed across 2 worker OS
+    processes (neither holds the full graph); the downstream worker is
+    SIGKILLed; the JobMaster redeploys only ITS vertices onto the
+    survivor with causal replay; the post-recovery digests are
+    bit-identical both to the dead worker's reported fences and to a
+    no-failure control run over the same exported record stream; a
+    deposed fencing token's DEPLOY is rejected. The upstream slice
+    ingests through a SocketFeedReader (the cross-worker source)."""
+    from clonos_tpu.api.feeds import ListFeedReader
+    from clonos_tpu.runtime.cluster import ClusterRunner
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    lease = str(tmp_path / "jm.lease")
+    lines = [((i * 37) % 997, 1 + i % 5) for i in range(600)]
+    srv, lport, _conns = _line_server(lines)
+
+    jm = JobMasterServer(heartbeat_timeout_s=2.0)
+    election = FileLeaderElection(lease, "jm-0", lease_ttl_s=30.0)
+    assert election.try_acquire()
+    runner_kw = dict(steps_per_epoch=4, log_capacity=512, max_epochs=64,
+                     inflight_ring_steps=64, seed=7, logical_time=True)
+    # feed_batch 4 < the source batch 8: the downstream slice demands
+    # records at half the rate the upstream can produce them, so early
+    # partially-filled socket pulls can never starve the blocking
+    # cross-worker reader at the end of the stream.
+    scheduler = sch.SlotPoolScheduler(
+        jm, election, "examples.spanning:build_job", runner_kw=runner_kw,
+        feed_batch=4, target_epochs=8, complete_every=2,
+        checkpoint_root=str(tmp_path / "ck"), deploy_timeout_s=300.0)
+
+    def spawn(eid):
+        return subprocess.Popen(
+            [sys.executable, "-m", "clonos_tpu", "slotworker",
+             "--jm", f"127.0.0.1:{jm.address[1]}",
+             "--executor-id", eid, "--slots", "2", "--lease", lease,
+             "--heartbeat-interval", "0.3", "--max-seconds", "600",
+             "--epoch-sleep", "0.25"],
+            cwd=REPO, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL, text=True)
+
+    pa, pb = spawn("a"), spawn("b")
+    try:
+        assert json.loads(pa.stdout.readline())["registered"] == "a"
+        assert json.loads(pb.stdout.readline())["registered"] == "b"
+        deadline = time.monotonic() + 30
+        while {"a", "b"} - set(jm.registered()):
+            assert time.monotonic() < deadline
+            time.sleep(0.05)
+
+        placements = scheduler.deploy(external_feeds={
+            0: {"kind": "socket", "host": "127.0.0.1", "port": lport,
+                "num_subtasks": 1}})
+        assert placements == {0: "a", 1: "b"}
+        assert scheduler.standby == {0: "b", 1: "a"}
+
+        # Neither process holds the full job: each got only its slice.
+        da, _ = _read_status(pa, lambda st: st.get("deployed") == 0)
+        db, _ = _read_status(pb, lambda st: st.get("deployed") == 1)
+        assert da["vertices"] == [0, 1] and db["vertices"] == [2, 3]
+        assert not da["recovered"] and not db["recovered"]
+
+        # Upstream drains the socket and finishes; its edge export stays
+        # up (final), so the downstream can never deadlock on it.
+        _read_status(pa, lambda st: st.get("finished") == 0)
+
+        # Downstream fences: record digests, mirror each one, kill at
+        # epoch >= 5 (checkpoints 0, 2, 4 completed by then).
+        digests_b = {}
+
+        def at_fence(st):
+            if "group" in st and "digest" in st:
+                scheduler.sync()
+            return st.get("epoch", -1) >= 5 or "finished" in st
+
+        _, digests_b = _read_status(pb, at_fence)
+        pb.send_signal(signal.SIGKILL)
+        pb.wait(timeout=15)
+        for line in pb.stdout:            # drain pre-kill reports
+            try:
+                st = json.loads(line)
+            except ValueError:
+                break
+            if "group" in st and "digest" in st:
+                digests_b[st["global_step"]] = st["digest"]
+
+        deadline = time.monotonic() + 20
+        while "b" not in scheduler.failed_workers():
+            assert time.monotonic() < deadline, "heartbeat expiry not seen"
+            time.sleep(0.1)
+
+        # A deposed JobMaster's DEPLOY is rejected at the worker's door.
+        with pytest.raises(RuntimeError,
+                           match="stale fencing|lease claim"):
+            scheduler._send_deploy(
+                "a", {"group": 1, "fencing_epoch": election.epoch - 1})
+
+        # Redeploy ONLY the dead worker's group, onto its standby.
+        moved = scheduler.recover_worker("b")
+        assert moved == {1: "a"}
+        assert scheduler.placements == {0: "a", 1: "a"}
+
+        # The rebuilt slice's replayed state is bit-identical to what the
+        # DEAD worker reported at that fence.
+        dep, _ = _read_status(pa, lambda st: st.get("deployed") == 1)
+        assert dep["recovered"] and dep["vertices"] == [2, 3]
+        assert dep["global_step"] > 0
+        assert dep["global_step"] in digests_b, \
+            "recovery fence was never reported by the dead worker"
+        assert dep["digest"] == digests_b[dep["global_step"]]
+
+        # ...and the rebuilt slice RUNS ON to the job's target.
+        fin, digests_a = _read_status(pa, lambda st:
+                                      st.get("finished") == 1)
+        assert fin["global_step"] == 8 * runner_kw["steps_per_epoch"]
+
+        # No-failure control: the same slice over the same exported
+        # stream, in this process. Every fence digest — the dead
+        # worker's, the recovery fence, and the rebuilt continuation —
+        # must be bit-identical to it.
+        host, eport = scheduler._export_addr[1]
+        cl = tp.ControlClient((host, eport))
+        rt, resp = cl.call(tp.FETCH_EDGE, tp.pack_json(
+            {"edge": 1, "start": 0, "count": 1 << 20}))
+        assert rt == tp.EDGE_DATA
+        hlen = int.from_bytes(resp[:4], "little")
+        hdr = tp.unpack_json(resp[4: 4 + hlen])
+        assert hdr["final"], "upstream export should be finished"
+        recs = np.frombuffer(resp[4 + hlen:], np.int32).reshape(-1, 2)
+        cl.close()
+
+        job = _spanning_job()
+        sub1, _v, feeds1, _e = job.subgraph([2, 3], feed_batch_size=4)
+        ctrl = ClusterRunner(sub1, **runner_kw)
+        ctrl.executor.register_feed(feeds1[1],
+                                    ListFeedReader([recs.tolist()]))
+        ctrl_digests = _epochs(ctrl, 8)
+
+        assert dep["digest"] == ctrl_digests[dep["global_step"]]
+        for step, d in digests_b.items():
+            assert d == ctrl_digests[step], \
+                f"dead worker's fence {step} diverges from no-failure run"
+        for step, d in digests_a.items():
+            assert d == ctrl_digests[step], \
+                f"rebuilt fence {step} diverges from no-failure run"
+    finally:
+        for p in (pa, pb):
+            if p.poll() is None:
+                p.kill()
+        scheduler.close()
+        jm.close()
+        srv.close()
