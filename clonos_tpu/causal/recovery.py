@@ -42,6 +42,7 @@ from clonos_tpu.api.operators import OpContext, Operator, TwoInputOperator
 from clonos_tpu.api.records import RecordBatch
 from clonos_tpu.causal import determinant as det
 from clonos_tpu.causal import log as clog
+from clonos_tpu.obs import get_tracer as _get_tracer
 
 
 class RecoveryState(enum.Enum):
@@ -467,6 +468,13 @@ class RecoveryManager:
     def _goto(self, s: RecoveryState) -> None:
         self.state = s
         self.transitions.append(s)
+        tr = _get_tracer()
+        if tr.enabled:
+            # FSM transitions as instants (reference RecoveryManager
+            # logs each state change) — the fine-grained layer under
+            # the recovery.* phase spans the cluster runner emits.
+            tr.event("recovery.fsm", state=s.name, flat=self.flat_subtask,
+                     vertex=self.vertex_id, subtask=self.subtask)
 
     # --- events (reference notify* methods) ---------------------------------
 

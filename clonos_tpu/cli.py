@@ -35,16 +35,30 @@ def _load_job(spec: str):
     return job
 
 
+def _setup_tracer(args, service: str):
+    """Opt-in tracing: ``--trace-dir`` installs the process tracer
+    writing trace-<service>.jsonl there. Returns the tracer or None."""
+    if getattr(args, "trace_dir", None) is None:
+        return None
+    import os
+    from clonos_tpu import obs
+    os.makedirs(args.trace_dir, exist_ok=True)
+    return obs.configure(service, path=os.path.join(
+        args.trace_dir, f"trace-{service}.jsonl"))
+
+
 def cmd_run(args) -> int:
     from clonos_tpu.runtime.cluster import ClusterRunner
 
+    tracer = _setup_tracer(args, "run")
     job = _load_job(args.job)
     runner = ClusterRunner(job, steps_per_epoch=args.steps_per_epoch,
                            checkpoint_dir=args.checkpoint_dir)
     endpoint = None
     if args.metrics_port is not None:
         from clonos_tpu.utils.metrics import MetricsEndpoint
-        endpoint = MetricsEndpoint(runner.metrics, port=args.metrics_port)
+        endpoint = MetricsEndpoint(runner.metrics, port=args.metrics_port,
+                                   tracer=tracer)
         print(f"# metrics: http://{endpoint.address[0]}:"
               f"{endpoint.address[1]}/metrics", file=sys.stderr)
     t0 = time.monotonic()
@@ -108,6 +122,7 @@ def cmd_worker(args) -> int:
     from clonos_tpu.runtime.remote import (HostLogEndpoint,
                                            TaskExecutorClient)
 
+    _setup_tracer(args, args.executor_id)
     ctx = distributed.initialize(args.coordinator, args.num_processes,
                                  args.process_id)
     job = _load_job(args.job)
@@ -158,11 +173,23 @@ def cmd_slotworker(args) -> int:
     slots. One JSON line per deployment and per (group, epoch)."""
     from clonos_tpu.runtime.scheduler import SliceWorker
 
+    tracer = _setup_tracer(args, args.executor_id)
     host, _, port = args.jm.partition(":")
     worker = SliceWorker(
         args.executor_id, (host, int(port)), lease_path=args.lease,
         slots=args.slots, bind_host=args.bind_host,
         heartbeat_interval=args.heartbeat_interval)
+    endpoint = None
+    if args.metrics_port is not None:
+        from clonos_tpu.utils.metrics import (MetricRegistry,
+                                              MetricsEndpoint)
+        # The worker's metric view is its per-slice snapshot cache (the
+        # same dict its heartbeats piggyback to the JobMaster).
+        endpoint = MetricsEndpoint(
+            MetricRegistry(), port=args.metrics_port,
+            extra=lambda: dict(worker._metrics_cache), tracer=tracer)
+        print(f"# metrics: http://{endpoint.address[0]}:"
+              f"{endpoint.address[1]}/metrics", file=sys.stderr)
     print(json.dumps({"registered": args.executor_id,
                       "deploy_port": worker.endpoint.address[1],
                       "slots": args.slots}), flush=True)
@@ -171,6 +198,36 @@ def cmd_slotworker(args) -> int:
                    epoch_sleep=args.epoch_sleep)
     finally:
         worker.close()
+        if endpoint is not None:
+            endpoint.close()
+    return 0
+
+
+def cmd_trace(args) -> int:
+    """Dump / convert recorded trace files (``clonos_tpu trace``):
+    summary by default, Chrome trace_event JSON with ``--chrome`` (the
+    output is validated before writing — Perfetto-loadable or error)."""
+    from clonos_tpu import obs
+
+    records = obs.load_jsonl(args.files)
+    if args.trace_id:
+        records = [r for r in records if r.get("trace") == args.trace_id]
+    if args.chrome:
+        doc = obs.to_chrome(records)
+        n = obs.validate_chrome(doc)
+        with open(args.chrome, "w") as f:
+            json.dump(doc, f)
+        print(json.dumps({"events": n, "out": args.chrome}))
+        return 0
+    summary = obs.summarize(records)
+    timeline = summary.pop("timeline")
+    print(json.dumps(summary, indent=2, default=str))
+    if args.timeline:
+        for ev in timeline:
+            dur = (f" dur={ev['dur'] * 1e3:.1f}ms"
+                   if ev.get("dur") is not None else "")
+            print(f"{ev['ts']:.6f} [{ev['service']}] "
+                  f"{ev['ph']} {ev['name']}{dur}")
     return 0
 
 
@@ -183,11 +240,15 @@ def main(argv=None) -> int:
     pr.add_argument("--epochs", type=int, default=4)
     pr.add_argument("--steps-per-epoch", type=int, default=16)
     pr.add_argument("--checkpoint-dir", default=None)
-    pr.set_defaults(fn=cmd_run)
-
     pr.add_argument("--metrics-port", type=int, default=None,
                     help="serve /metrics (Prometheus) + /metrics.json "
-                         "on this port while running (0 = ephemeral)")
+                         "+ /trace on this port while running "
+                         "(0 = ephemeral)")
+    pr.add_argument("--trace-dir", default=None,
+                    help="record trace spans to trace-run.jsonl here "
+                         "(off by default: zero overhead)")
+    pr.set_defaults(fn=cmd_run)
+
     pi = sub.add_parser("info", help="describe a job graph")
     pi.add_argument("job")
     pi.set_defaults(fn=cmd_info)
@@ -226,6 +287,9 @@ def main(argv=None) -> int:
                          "(multi-host bootstrap)")
     pw.add_argument("--num-processes", type=int, default=None)
     pw.add_argument("--process-id", type=int, default=None)
+    pw.add_argument("--trace-dir", default=None,
+                    help="record trace spans to "
+                         "trace-<executor-id>.jsonl here")
     pw.set_defaults(fn=cmd_worker)
 
     ps = sub.add_parser("slotworker",
@@ -244,7 +308,30 @@ def main(argv=None) -> int:
     ps.add_argument("--epoch-sleep", type=float, default=0.0,
                     help="pause after each served epoch round (lets "
                          "tests kill mid-run)")
+    ps.add_argument("--metrics-port", type=int, default=None,
+                    help="serve this worker's /metrics + /metrics.json "
+                         "+ /trace on this port (0 = ephemeral)")
+    ps.add_argument("--trace-dir", default=None,
+                    help="record trace spans to "
+                         "trace-<executor-id>.jsonl here; DEPLOY "
+                         "headers make the spans join the JobMaster's "
+                         "trace id (off by default: zero overhead)")
     ps.set_defaults(fn=cmd_slotworker)
+
+    pt = sub.add_parser("trace", help="summarize or convert recorded "
+                                      "trace JSON-lines files")
+    pt.add_argument("files", nargs="+",
+                    help="trace-*.jsonl files (a run's set of "
+                         "per-process files reconstructs one timeline)")
+    pt.add_argument("--chrome", default=None, metavar="OUT",
+                    help="write Chrome trace_event JSON (load in "
+                         "Perfetto / about:tracing)")
+    pt.add_argument("--trace-id", default=None,
+                    help="keep only records of this trace id")
+    pt.add_argument("--timeline", action="store_true",
+                    help="also print the dominant trace's ordered "
+                         "event timeline")
+    pt.set_defaults(fn=cmd_trace)
 
     args = p.parse_args(argv)
     return args.fn(args)

@@ -123,3 +123,23 @@ HEARTBEAT_TIMEOUT_MS = ConfigOption(
     "heartbeat.timeout", 5000,
     description="Missed-heartbeat window before a task executor is declared "
                 "failed.")
+
+# --- observability (clonos_tpu/obs) -----------------------------------------
+
+TRACING_ENABLED = ConfigOption(
+    "observability.tracing.enabled", False,
+    description="Record distributed trace spans (epoch/checkpoint/recovery "
+                "lifecycles) and propagate trace context on control-wire "
+                "headers. Off = the NullTracer: no wire fields, no "
+                "per-record work.")
+
+TRACE_DIR = ConfigOption(
+    "observability.tracing.dir", "/tmp/clonos_tpu/traces",
+    description="Directory for per-process trace-<service>.jsonl files "
+                "(convert with `clonos_tpu trace`).")
+
+TRACE_BUFFER_EVENTS = ConfigOption(
+    "observability.tracing.buffer-events", 8192,
+    validator=lambda v: v > 0,
+    description="Flight-recorder ring size: most recent trace records kept "
+                "in memory and served on the metrics endpoint's /trace.")

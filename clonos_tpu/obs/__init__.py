@@ -1,0 +1,18 @@
+"""Observability: distributed tracing + recovery flight recorder.
+
+See obs/trace.py for the design. Typical use::
+
+    from clonos_tpu import obs
+
+    obs.configure("jm", path="traces/trace-jm.jsonl")
+    with obs.get_tracer().span("recovery.redeploy", worker="b"):
+        ...
+"""
+
+from .trace import (NullTracer, Tracer, configure, get_tracer,  # noqa: F401
+                    reset)
+from .chrome import (load_jsonl, summarize, to_chrome,  # noqa: F401
+                     validate_chrome)
+
+__all__ = ["Tracer", "NullTracer", "get_tracer", "configure", "reset",
+           "load_jsonl", "to_chrome", "validate_chrome", "summarize"]

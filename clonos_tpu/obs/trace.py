@@ -1,0 +1,272 @@
+"""Distributed tracing + recovery flight recorder.
+
+The reference's observability story is metric scopes that follow
+job→task→operator (MetricRegistryImpl + ScopeFormats) and ad-hoc log
+lines around the recovery path (RecoveryManager.java state transitions,
+JobCausalLogImpl.java:268-298 occupancy logging). Since the slot-pool
+scheduler (runtime/scheduler.py) one job spans multiple worker OS
+processes, and the question the paper's headline claim hangs on —
+*where does the time go during an epoch and during a recovery?* — has
+no single-process answer anymore. This module gives the framework spans
+that follow a job across process boundaries:
+
+- :class:`Tracer` mints trace/span ids, records **complete spans**
+  (``ph: "X"``: wall ``ts`` + ``dur``) and **instant events**
+  (``ph: "i"``), each tagged with the trace id, the emitting service
+  (``jm``, a worker id, …) and pid. Records go to (a) a bounded
+  in-memory ring — the flight recorder, dumpable after the fact and
+  served on ``MetricsEndpoint``'s ``/trace`` — and (b) optionally a
+  JSON-lines file (one handle, append mode, flushed per record so a
+  SIGKILLed worker's trace survives it).
+- **Context propagation**: :meth:`Tracer.wire_context` returns a small
+  dict (``{"trace_id", "span"}``) that control-wire JSON headers carry
+  as a ``trace`` field (DEPLOY / TRIGGER_CHECKPOINT /
+  DETERMINANT_REQUEST / FETCH_EDGE — parallel/transport.py); the
+  receiving process calls :meth:`Tracer.adopt` and its subsequent spans
+  land under the SAME trace id, so one recovery reconstructs from the
+  JobMaster's and every worker's files together.
+- **Zero overhead by default**: the process-global tracer starts as
+  :class:`NullTracer` (``enabled`` False, every method a no-op,
+  ``wire_context()`` → None so senders add no wire field). Enabling is
+  an explicit opt-in (:func:`configure`, the ``--trace-dir`` CLI flags,
+  or the ``observability.tracing.enabled`` config option).
+
+Convert a recorded file with ``clonos_tpu trace run.jsonl --chrome
+out.json`` (tools/trace2chrome.py) and load it in Perfetto / Chrome
+``about:tracing``.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+import uuid
+from typing import Any, Deque, Dict, List, Optional
+
+
+def _new_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+class _NullSpan:
+    """No-op context manager handed out by the disabled tracer."""
+
+    __slots__ = ()
+    span_id = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class NullTracer:
+    """The disabled tracer: every operation is a no-op and
+    ``wire_context()`` is None, so instrumented call sites add neither
+    wire fields nor per-record work to the hot path."""
+
+    enabled = False
+    trace_id = None
+    service = None
+
+    def span(self, name: str, **args) -> _NullSpan:
+        return _NULL_SPAN
+
+    def event(self, name: str, **args) -> None:
+        pass
+
+    def complete(self, name: str, dur_s: float, **args) -> None:
+        pass
+
+    def wire_context(self) -> None:
+        return None
+
+    def adopt(self, ctx) -> None:
+        pass
+
+    def records(self) -> List[dict]:
+        return []
+
+    def close(self) -> None:
+        pass
+
+
+class _Span:
+    """A live span: context manager that emits one complete record on
+    exit. Exceptions propagate; the span still closes (its ``error``
+    arg records the fact)."""
+
+    __slots__ = ("_tracer", "name", "span_id", "parent", "args", "_t0")
+
+    def __init__(self, tracer: "Tracer", name: str,
+                 parent: Optional[str], args: Dict[str, Any]):
+        self._tracer = tracer
+        self.name = name
+        self.span_id = _new_id()
+        self.parent = parent
+        self.args = args
+        self._t0 = 0.0
+
+    def __enter__(self) -> "_Span":
+        self._t0 = self._tracer._clock()
+        self._tracer._push(self.span_id)
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        self._tracer._pop()
+        if exc_type is not None:
+            self.args = dict(self.args, error=repr(exc))
+        self._tracer._emit(
+            self.name, "X", self._t0,
+            dur=self._tracer._clock() - self._t0,
+            span=self.span_id, parent=self.parent, args=self.args)
+        return False
+
+
+class Tracer:
+    """Process tracer: one trace id (minted or adopted), a bounded
+    flight-recorder ring, and an optional JSON-lines file sink.
+
+    Thread-safe: spans/events may be emitted from server threads (the
+    control-plane handlers) as well as the main loop; the parent-span
+    stack is thread-local so concurrent spans nest correctly per
+    thread."""
+
+    enabled = True
+
+    def __init__(self, service: str, path: Optional[str] = None,
+                 trace_id: Optional[str] = None, clock=time.time,
+                 buffer: int = 8192):
+        self.service = service
+        self.trace_id = trace_id or _new_id()
+        self._path = path
+        self._clock = clock
+        self._file = None
+        self._lock = threading.Lock()
+        self._local = threading.local()
+        #: the flight recorder: most recent records, bounded
+        self._ring: Deque[dict] = collections.deque(maxlen=buffer)
+        self._pid = os.getpid()
+
+    # --- span stack (thread-local parents) -----------------------------------
+
+    def _stack(self) -> List[str]:
+        st = getattr(self._local, "stack", None)
+        if st is None:
+            st = self._local.stack = []
+        return st
+
+    def _push(self, span_id: str) -> None:
+        self._stack().append(span_id)
+
+    def _pop(self) -> None:
+        st = self._stack()
+        if st:
+            st.pop()
+
+    def current_span(self) -> Optional[str]:
+        st = self._stack()
+        return st[-1] if st else None
+
+    # --- recording -----------------------------------------------------------
+
+    def _emit(self, name: str, ph: str, ts: float, dur: float = 0.0,
+              span: Optional[str] = None, parent: Optional[str] = None,
+              args: Optional[Dict[str, Any]] = None) -> None:
+        rec = {"ts": ts, "name": name, "ph": ph,
+               "trace": self.trace_id, "service": self.service,
+               "pid": self._pid, "tid": threading.get_ident() & 0xFFFF,
+               "span": span or _new_id(),
+               "parent": parent if parent is not None
+               else self.current_span()}
+        if ph == "X":
+            rec["dur"] = dur
+        if args:
+            rec["args"] = args
+        with self._lock:
+            self._ring.append(rec)
+            if self._path is not None:
+                # One append-mode handle for the tracer's lifetime,
+                # flushed per record: a SIGKILL loses at most the record
+                # being written, never the buffered history.
+                if self._file is None:
+                    self._file = open(self._path, "a")
+                self._file.write(json.dumps(rec, default=str) + "\n")
+                self._file.flush()
+
+    def span(self, name: str, **args) -> _Span:
+        """Context manager: records a complete span over the ``with``
+        body, parented to the enclosing span of this thread."""
+        return _Span(self, name, self.current_span(), args)
+
+    def event(self, name: str, **args) -> None:
+        """Instant event at now."""
+        self._emit(name, "i", self._clock(), args=args)
+
+    def complete(self, name: str, dur_s: float, **args) -> None:
+        """Record an already-measured span ending now (the caller timed
+        it; ``ts`` is back-dated so the timeline lays out correctly)."""
+        self._emit(name, "X", self._clock() - dur_s, dur=dur_s, args=args)
+
+    # --- context propagation -------------------------------------------------
+
+    def wire_context(self) -> Dict[str, Any]:
+        """The ``trace`` field control-wire JSON headers carry."""
+        return {"trace_id": self.trace_id, "span": self.current_span()}
+
+    def adopt(self, ctx: Optional[Dict[str, Any]]) -> None:
+        """Join the sender's trace: subsequent spans/events from this
+        process land under the sender's trace id (idempotent)."""
+        if ctx and ctx.get("trace_id"):
+            self.trace_id = str(ctx["trace_id"])
+
+    # --- flight recorder -----------------------------------------------------
+
+    def records(self) -> List[dict]:
+        with self._lock:
+            return list(self._ring)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._file is not None:
+                self._file.close()
+                self._file = None
+
+
+# --- process-global tracer ---------------------------------------------------
+
+_global_tracer = NullTracer()
+_global_lock = threading.Lock()
+
+
+def get_tracer():
+    """The process tracer (NullTracer unless :func:`configure` ran)."""
+    return _global_tracer
+
+
+def configure(service: str, path: Optional[str] = None,
+              trace_id: Optional[str] = None, **kw) -> Tracer:
+    """Install a real process tracer (replacing the previous one, which
+    is closed). The opt-in gate for all instrumentation."""
+    global _global_tracer
+    with _global_lock:
+        old = _global_tracer
+        _global_tracer = Tracer(service, path=path, trace_id=trace_id,
+                                **kw)
+        old.close()
+        return _global_tracer
+
+
+def reset() -> None:
+    """Back to the disabled NullTracer (tests; also closes the file)."""
+    global _global_tracer
+    with _global_lock:
+        _global_tracer.close()
+        _global_tracer = NullTracer()

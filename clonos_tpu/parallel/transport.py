@@ -80,6 +80,32 @@ def unpack_json(b: bytes) -> Any:
     return json.loads(b.decode("utf-8"))
 
 
+# --- trace-context convention ------------------------------------------------
+# Control messages whose payload is a JSON header (DEPLOY,
+# TRIGGER_CHECKPOINT, DETERMINANT_REQUEST, FETCH_EDGE) MAY carry a
+# ``trace`` field: the sender's obs.Tracer.wire_context() dict. Receivers
+# adopt it so both sides' spans land under one trace id. A disabled
+# tracer has wire_context() None — these helpers then leave the header
+# untouched, keeping the wire bytes identical to an untraced build.
+
+def attach_trace(header: Dict[str, Any]) -> Dict[str, Any]:
+    """Add the process tracer's context to a JSON header (in place)."""
+    from clonos_tpu.obs import get_tracer
+    ctx = get_tracer().wire_context()
+    if ctx is not None:
+        header["trace"] = ctx
+    return header
+
+
+def adopt_trace(header: Dict[str, Any]) -> None:
+    """Join the trace a received JSON header carries (no-op when the
+    local tracer is disabled or the header has no ``trace``)."""
+    from clonos_tpu.obs import get_tracer
+    tr = get_tracer()
+    if tr.enabled:
+        tr.adopt(header.get("trace"))
+
+
 class ControlServer:
     """Threaded request/response endpoint. ``handler(mtype, payload) ->
     (mtype, payload)`` runs per request; one TCP connection may carry many

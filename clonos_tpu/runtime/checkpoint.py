@@ -34,6 +34,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..obs import get_tracer
+
 
 @dataclasses.dataclass
 class CompletedCheckpoint:
@@ -191,6 +193,10 @@ class CheckpointCoordinator:
         self._complete_listeners: List[Callable[[int], None]] = []
         self._writer_lock = threading.Lock()
         self._async_threads: List[threading.Thread] = []
+        self._trigger_wall: Dict[int, float] = {}     # cid -> trigger time
+        #: cid -> trigger→complete latency (read by the runner's
+        #: ``checkpoint.trigger-to-complete-ms`` histogram hook)
+        self.completion_latency_s: Dict[int, float] = {}
 
     # --- listener registration ----------------------------------------------
 
@@ -219,6 +225,9 @@ class CheckpointCoordinator:
         if checkpoint_id in self._ignored:
             return
         self._pending[checkpoint_id] = set(range(self.num_subtasks))
+        self._trigger_wall[checkpoint_id] = time.time()
+        get_tracer().event("checkpoint.trigger", cid=checkpoint_id,
+                           subtasks=self.num_subtasks)
         snap_start = time.monotonic()
         if not self.storage.wants_host and not owned:
             # The defensive copy must happen BEFORE returning to the
@@ -278,8 +287,19 @@ class CheckpointCoordinator:
                 self.storage.mark_complete(checkpoint_id)
             except NotImplementedError:          # custom storages
                 pass
+            tr = get_tracer()
+            trig = self._trigger_wall.pop(checkpoint_id, None)
+            if trig is not None:
+                lat = time.time() - trig
+                self.completion_latency_s[checkpoint_id] = lat
+                while len(self.completion_latency_s) > 64:
+                    del self.completion_latency_s[
+                        min(self.completion_latency_s)]
+                tr.complete("checkpoint", lat, cid=checkpoint_id,
+                            size_bytes=ckpt.size_bytes)
             for fn in self._complete_listeners:
                 fn(checkpoint_id)
+            tr.event("checkpoint.truncate", cid=checkpoint_id)
             for fn in self._listeners:
                 fn(ckpt)
             self._retain()

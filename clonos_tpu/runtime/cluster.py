@@ -49,6 +49,7 @@ from clonos_tpu.graph.job_graph import JobGraph, PartitionType
 from clonos_tpu.inflight import log as ifl
 from clonos_tpu.parallel import routing
 from clonos_tpu.runtime import checkpoint as cp
+from clonos_tpu.obs import get_tracer
 from clonos_tpu.runtime.executor import (DETS_PER_STEP, JobCarry,
                                          LeanSnapshot, LocalExecutor)
 
@@ -248,6 +249,14 @@ class ClusterRunner:
                      if self.standbys.latest else 0))
         self._m_recovery_ms = g.histogram("recovery.duration-ms")
         self._m_recovered_records = g.counter("recovery.records-replayed")
+        self._m_epoch_steps_ms = g.histogram("epoch.steps-ms")
+        self._m_epoch_fence_ms = g.histogram("epoch.fence-ms")
+        self._m_ckpt_latency_ms = g.histogram(
+            "checkpoint.trigger-to-complete-ms")
+        self.coordinator.subscribe_completion(
+            lambda cid: self._m_ckpt_latency_ms.update(
+                self.coordinator.completion_latency_s.get(cid, 0.0) * 1e3))
+        self._mgroup = g
         self.watchdog = met.LogOccupancyWatchdog(self.executor, g)
         #: compiled recovery programs, keyed by (kind, params) — populated
         #: lazily and by prewarm_recovery() (warm standby: no XLA compile
@@ -1041,69 +1050,90 @@ class ClusterRunner:
                 f"call recover() first")
         closed = self.executor.epoch_id
         n = self.executor.steps_per_epoch - self.executor.step_in_epoch
-        self.executor.run_epoch()
-        self.global_step += n
-        self._fence_step[self.executor.epoch_id] = self.global_step
-        self.heartbeats.beat_all_except(self.failed)
-        self._m_steps.inc(n)
-        self._m_epochs.inc()
-        # One fused device read per epoch: overflow flags + record total +
-        # fence log heads (the tunnel round-trip is the cost unit here,
-        # not device work).
-        vec = self.executor.health_vector()
-        nf = 4 + len(self.executor.carry.out_rings)
-        total_records = int(vec[nf])
-        # The heads at this fence ARE checkpoint ``closed``'s log heads
-        # (the SOURCE_CHECKPOINT appends below come after and belong to
-        # the new epoch) — recovery's patch phase reads them from here
-        # instead of paying a device round-trip on the failure path.
-        self._ck_log_heads[closed] = vec[nf + 1:].astype(np.int64)
-        # Bounded even when checkpoints never complete (the completion
-        # hook prunes harder): a pruned-but-needed entry only costs the
-        # patch fallback's one device read.
-        if len(self._ck_log_heads) > 128:
-            for k in sorted(self._ck_log_heads)[:-128]:
-                del self._ck_log_heads[k]
-        delta_records = total_records - self._last_records_total
-        self._m_records.mark(delta_records)
-        self._last_records_total = total_records
-        # Overflow guards at every roll: an un-truncated ring that wrapped
-        # has silently clobbered recovery state — fail loudly, never limp.
-        violations = self.executor.overflow_messages(vec)
-        if violations:
-            raise OverflowError_("; ".join(violations))
-        # Host epoch control plane mirrors the fence.
-        self.epoch_tracker.inc_record_count(delta_records)
-        self.epoch_tracker.start_new_epoch(self.executor.epoch_id)
-        if self.latency is not None:
-            self.latency.observe()
-        # Checkpoint at the fence: the lean fence snapshot (op state +
-        # offsets; logs/rings are truncated on completion, not persisted).
-        self.coordinator.trigger(closed, self.executor.lean_snapshot(),
-                                 async_write=False, owned=True)
-        # The checkpoint-trigger RPC arrival is nondeterministic in the
-        # reference and logged by every source
-        # (StreamTask.performCheckpoint:833-840); fence-aligned here, but
-        # the determinant is still recorded for replay/wire parity — one
-        # fused device append for all sources, AFTER the lean snapshot so
-        # the checkpointed log heads stay aligned with the fence offsets
-        # (the rows belong to the new epoch).
-        if self._source_flats:
-            t_ms = (self.executor.step_input_history[-1][0]
-                    if self.executor.step_input_history else 0)
-            self.executor.append_async_many(
-                self._source_flats,
-                det.SourceCheckpointDeterminant(
-                    record_count=self.executor.global_record_stamp(),
-                    checkpoint_id=closed, timestamp=t_ms))
-        for tl in self.txn_logs.values():
-            tl.seal(closed)
-        # Before completion: ack_all truncates rings up to this fence,
-        # so anything reading their fresh steps (edge exports) goes now.
-        for hook in self.fence_hooks:
-            hook(closed)
-        if complete_checkpoint:
-            self.coordinator.ack_all(closed)
+        tr = get_tracer()
+        epoch_span = tr.span("epoch", epoch=closed, steps=n)
+        epoch_span.__enter__()
+        try:
+            t0 = _time.monotonic()
+            self.executor.run_epoch()
+            steps_s = _time.monotonic() - t0
+            self._m_epoch_steps_ms.update(steps_s * 1e3)
+            tr.complete("epoch.steps", steps_s, epoch=closed, steps=n)
+            t_fence = _time.monotonic()
+            self.global_step += n
+            self._fence_step[self.executor.epoch_id] = self.global_step
+            self.heartbeats.beat_all_except(self.failed)
+            self._m_steps.inc(n)
+            self._m_epochs.inc()
+            # One fused device read per epoch: overflow flags + record
+            # total + fence log heads (the tunnel round-trip is the cost
+            # unit here, not device work).
+            vec = self.executor.health_vector()
+            nf = 4 + len(self.executor.carry.out_rings)
+            total_records = int(vec[nf])
+            # The heads at this fence ARE checkpoint ``closed``'s log
+            # heads (the SOURCE_CHECKPOINT appends below come after and
+            # belong to the new epoch) — recovery's patch phase reads
+            # them from here instead of paying a device round-trip on
+            # the failure path.
+            self._ck_log_heads[closed] = vec[nf + 1:].astype(np.int64)
+            # Bounded even when checkpoints never complete (the
+            # completion hook prunes harder): a pruned-but-needed entry
+            # only costs the patch fallback's one device read.
+            if len(self._ck_log_heads) > 128:
+                for k in sorted(self._ck_log_heads)[:-128]:
+                    del self._ck_log_heads[k]
+            delta_records = total_records - self._last_records_total
+            self._m_records.mark(delta_records)
+            self._last_records_total = total_records
+            # Overflow guards at every roll: an un-truncated ring that
+            # wrapped has silently clobbered recovery state — fail
+            # loudly, never limp.
+            violations = self.executor.overflow_messages(vec)
+            if violations:
+                raise OverflowError_("; ".join(violations))
+            # Host epoch control plane mirrors the fence.
+            self.epoch_tracker.inc_record_count(delta_records)
+            self.epoch_tracker.start_new_epoch(self.executor.epoch_id)
+            if self.latency is not None:
+                self.latency.observe()
+            # Checkpoint at the fence: the lean fence snapshot (op state
+            # + offsets; logs/rings are truncated on completion, not
+            # persisted).
+            self.coordinator.trigger(closed, self.executor.lean_snapshot(),
+                                     async_write=False, owned=True)
+            # The checkpoint-trigger RPC arrival is nondeterministic in
+            # the reference and logged by every source
+            # (StreamTask.performCheckpoint:833-840); fence-aligned here,
+            # but the determinant is still recorded for replay/wire
+            # parity — one fused device append for all sources, AFTER
+            # the lean snapshot so the checkpointed log heads stay
+            # aligned with the fence offsets (the rows belong to the new
+            # epoch).
+            if self._source_flats:
+                t_ms = (self.executor.step_input_history[-1][0]
+                        if self.executor.step_input_history else 0)
+                self.executor.append_async_many(
+                    self._source_flats,
+                    det.SourceCheckpointDeterminant(
+                        record_count=self.executor.global_record_stamp(),
+                        checkpoint_id=closed, timestamp=t_ms))
+            for tl in self.txn_logs.values():
+                tl.seal(closed)
+            # Before completion: ack_all truncates rings up to this
+            # fence, so anything reading their fresh steps (edge
+            # exports) goes now.
+            for hook in self.fence_hooks:
+                hook(closed)
+            if complete_checkpoint:
+                self.coordinator.ack_all(closed)
+            fence_s = _time.monotonic() - t_fence
+            self._m_epoch_fence_ms.update(fence_s * 1e3)
+            tr.complete("epoch.fence", fence_s, epoch=closed)
+        except BaseException as e:
+            epoch_span.__exit__(type(e), e, e.__traceback__)
+            raise
+        epoch_span.__exit__(None, None, None)
 
     def step(self) -> None:
         if self.failed:
@@ -1249,6 +1279,8 @@ class ClusterRunner:
         def _clock(name: str, since: float) -> float:
             now = _time.monotonic()
             phases[name] = phases.get(name, 0.0) + (now - since) * 1e3
+            get_tracer().complete(f"recovery.{name}", now - since,
+                                  drill=drill)
             return now
 
         patched = self.executor.carry
@@ -1685,6 +1717,14 @@ class ClusterRunner:
             self.reports.append(report)
             self._m_recovery_ms.update(report.recovery_ms)
             self._m_recovered_records.inc(report.records_replayed)
+            # Per-phase latency distributions (recovery.replay-ms p50/p99
+            # etc.) — the tuning surface for the paper's headline claim.
+            for pname, ms in phases.items():
+                self._mgroup.histogram(f"recovery.{pname}-ms").update(ms)
+        get_tracer().complete(
+            "recovery", report.recovery_ms / 1e3, drill=drill,
+            failed=list(failed), from_epoch=from_epoch,
+            steps_replayed=n_steps, records_replayed=total_records)
         return report
 
     def prewarm_recovery(self, vertex_ids: Optional[Sequence[int]] = None,

@@ -875,6 +875,12 @@ class LocalExecutor:
 
     def notify_checkpoint_complete(self, epoch: int) -> None:
         """Truncate determinant + in-flight logs for epochs <= ``epoch``."""
+        from clonos_tpu.obs import get_tracer
+        tr = get_tracer()
+        if tr.enabled:
+            # checkpoint-cadence, not per-step: the epoch fence ->
+            # truncation leg of the epoch lifecycle
+            tr.event("epoch.inflight_truncate", epoch=epoch)
         self.carry = self._jit_trunc(self.carry, epoch)
         if self.spill_logs is not None:
             for sl in self.spill_logs:

@@ -34,6 +34,7 @@ import numpy as np
 
 from clonos_tpu.causal import log as clog
 from clonos_tpu.causal import serde
+from clonos_tpu.obs import get_tracer
 from clonos_tpu.parallel import transport as tp
 
 
@@ -60,6 +61,8 @@ class JobMasterServer:
         self._ignored: List[int] = []
         self._slots: Dict[str, int] = {}
         self._tasks: Dict[Tuple[str, int], dict] = {}
+        #: executor_id -> last metric snapshot piggybacked on HEARTBEAT
+        self._hb_metrics: Dict[str, dict] = {}
         self._lock = threading.Lock()
         self.server = tp.ControlServer(self._handle, host, port)
         self.address = self.server.address
@@ -76,6 +79,9 @@ class JobMasterServer:
             info = tp.unpack_json(payload)
             with self._lock:
                 self._last[info["executor_id"]] = time.monotonic()
+                metrics = info.get("metrics")
+                if metrics is not None:
+                    self._hb_metrics[info["executor_id"]] = metrics
             return tp.OK, b""
         if mtype == tp.IGNORE_CHECKPOINT:
             info = tp.unpack_json(payload)
@@ -118,6 +124,17 @@ class JobMasterServer:
         with self._lock:
             return self._tasks.get((executor_id, group))
 
+    def cluster_metrics(self) -> Dict[str, object]:
+        """Cluster-wide metric view: every worker's last heartbeat
+        snapshot, flattened under ``worker.<executor_id>.`` — the
+        ``extra`` supplier for the JobMaster's MetricsEndpoint, so one
+        scrape covers the whole slot pool."""
+        with self._lock:
+            snaps = {eid: dict(m) for eid, m in self._hb_metrics.items()}
+        return {f"worker.{eid}.{name}": v
+                for eid, m in sorted(snaps.items())
+                for name, v in m.items()}
+
     def expired(self) -> List[str]:
         now = time.monotonic()
         with self._lock:
@@ -129,15 +146,22 @@ class JobMasterServer:
 
 
 class TaskExecutorClient:
-    """Executor-side stub: register once, heartbeat on a thread."""
+    """Executor-side stub: register once, heartbeat on a thread.
+
+    ``payload_fn`` (zero-arg, returns a dict) is merged into every
+    HEARTBEAT — the metric-piggyback hook. It runs on the heartbeat
+    thread, so it must return host-side data only (the worker caches a
+    snapshot on its MAIN loop; jax dispatch is main-thread-only)."""
 
     def __init__(self, executor_id: str, jm_address: Tuple[str, int],
                  interval_s: float = 1.0,
-                 info: Optional[dict] = None):
+                 info: Optional[dict] = None,
+                 payload_fn=None):
         self.executor_id = executor_id
         self._client = tp.ControlClient(tuple(jm_address))
         self._client.call_json(tp.REGISTER, {"executor_id": executor_id,
                                              **(info or {})})
+        self._payload_fn = payload_fn
         self._interval = interval_s
         #: consecutive heartbeat RPC failures (0 when healthy)
         self.missed_beats = 0
@@ -153,8 +177,13 @@ class TaskExecutorClient:
         # RPC. ``missed_beats`` surfaces persistent trouble.
         while not self._stop.wait(self._interval):
             try:
-                self._client.call_json(tp.HEARTBEAT,
-                                       {"executor_id": self.executor_id})
+                msg = {"executor_id": self.executor_id}
+                if self._payload_fn is not None:
+                    try:
+                        msg.update(self._payload_fn() or {})
+                    except Exception:
+                        pass       # the beat matters more than the extras
+                self._client.call_json(tp.HEARTBEAT, msg)
                 self.missed_beats = 0
             except (OSError, RuntimeError):
                 self.missed_beats += 1
@@ -268,6 +297,8 @@ class HostLogEndpoint:
         req = tp.unpack_json(payload)
         known = req.get("known_heads", {})
         encoding = req.get("encoding", "flat")
+        tp.adopt_trace(req)
+        tr = get_tracer()
         deltas = []
         floors: Dict[int, int] = {}
         with self._lock:
@@ -281,6 +312,12 @@ class HostLogEndpoint:
                 if lo - start >= rows.shape[0]:
                     continue
                 deltas.append((flat, lo, rows[lo - start:]))
+        if deltas and tr.enabled:
+            # only when rows are actually served — the mirror polls
+            # frequently and empty rounds would drown the trace
+            tr.event("determinants.served",
+                     flats=[d[0] for d in deltas],
+                     rows=int(sum(d[2].shape[0] for d in deltas)))
         frame = serde.encode_delta(deltas, encoding=encoding)
         # Response = u32 header length | JSON header | delta frame. The
         # floors (each owner log's truncation point) let mirrors release
@@ -443,9 +480,10 @@ class RemoteReplicaMirror:
         mirror applies the same truncation: rebase to the delta's start
         and absorb from there (a remote notifyCheckpointComplete)."""
         known = {str(f): self.head(f) for f in self.flats}
-        rt, resp = self._client.call(tp.DETERMINANT_REQUEST, tp.pack_json(
-            {"flats": self.flats, "known_heads": known,
-             "encoding": self.encoding}))
+        req = tp.attach_trace({"flats": self.flats, "known_heads": known,
+                               "encoding": self.encoding})
+        rt, resp = self._client.call(tp.DETERMINANT_REQUEST,
+                                     tp.pack_json(req))
         if rt == tp.ERROR:
             raise RuntimeError(tp.unpack_json(resp)["error"])
         hlen = int.from_bytes(resp[:4], "little")

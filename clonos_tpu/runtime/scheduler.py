@@ -73,6 +73,7 @@ import numpy as np
 
 from clonos_tpu.causal import serde
 from clonos_tpu.graph.job_graph import JobGraph, PartitionType
+from clonos_tpu.obs import get_tracer
 from clonos_tpu.parallel import transport as tp
 from clonos_tpu.parallel.distributed import standby_worker_order
 from clonos_tpu.runtime import remote as rm
@@ -289,6 +290,7 @@ class EdgeExportServer:
         if mtype != tp.FETCH_EDGE:
             return tp.ERROR, tp.pack_json({"error": f"bad mtype {mtype}"})
         req = tp.unpack_json(payload)
+        tp.adopt_trace(req)
         eidx, start, count = (int(req["edge"]), int(req["start"]),
                               int(req["count"]))
         if eidx not in self._recs:
@@ -301,6 +303,10 @@ class EdgeExportServer:
         avail = arr.shape[0]
         lo, hi = min(start, avail), min(start + count, avail)
         rows = np.ascontiguousarray(arr[lo:hi])
+        tr = get_tracer()
+        if tr.enabled and hi > lo:
+            # only non-empty serves — blocked readers poll this endpoint
+            tr.event("edge.serve", edge=eidx, start=lo, count=hi - lo)
         hdr = tp.pack_json({"edge": eidx, "start": lo,
                             "count": int(hi - lo), "avail": avail,
                             "floor": 0, "final": final})
@@ -348,8 +354,11 @@ class RemoteEdgeFeedReader:
         deadline = time.monotonic() + self._timeout
         while True:
             with self._lock:
-                rt, resp = self._client.call(tp.FETCH_EDGE, tp.pack_json(
-                    {"edge": self._edge, "start": start, "count": n}))
+                rt, resp = self._client.call(
+                    tp.FETCH_EDGE,
+                    tp.pack_json(tp.attach_trace(
+                        {"edge": self._edge, "start": start,
+                         "count": n})))
             if rt == tp.ERROR:
                 raise RuntimeError(tp.unpack_json(resp)["error"])
             hlen = int.from_bytes(resp[:4], "little")
@@ -509,13 +518,37 @@ class SliceWorker:
         self.bind_host = bind_host
         self.endpoint = TaskExecutorEndpoint(lease_path, bind_host)
         self._jm = tp.ControlClient(tuple(jm_address))
+        # Heartbeats piggyback the worker's last metric snapshot so the
+        # JobMaster aggregates a cluster view (JobMasterServer
+        # .cluster_metrics). The cache is refreshed on the MAIN loop —
+        # snapshot() evaluates watchdog gauges that read device state,
+        # and jax dispatch is main-thread-only — the heartbeat thread
+        # only ships the cached host dict.
+        self._metrics_cache: Dict[str, object] = {}
+        self._metrics_lock = threading.Lock()
         self.tx = rm.TaskExecutorClient(
             executor_id, jm_address, interval_s=heartbeat_interval,
             info={"slots": slots, "deploy_host": bind_host,
-                  "deploy_port": self.endpoint.address[1]})
+                  "deploy_port": self.endpoint.address[1]},
+            payload_fn=self._hb_payload)
         self.slices: Dict[int, _DeployedSlice] = {}
         self._emit = emit or (lambda obj: print(json.dumps(obj),
                                                 flush=True))
+
+    def _hb_payload(self) -> dict:
+        with self._metrics_lock:
+            cache = self._metrics_cache
+        return {"metrics": cache} if cache else {}
+
+    def _refresh_metrics(self) -> None:
+        """Main-thread snapshot of every slice's registry (replaces the
+        cache wholesale; the heartbeat thread only reads the old ref)."""
+        snap: Dict[str, object] = {}
+        for group, sl in self.slices.items():
+            for k, v in sl.runner.metrics.snapshot().items():
+                snap[f"group.{group}.{k}"] = v
+        with self._metrics_lock:
+            self._metrics_cache = snap
 
     def _task_state(self, group: int, state: str, **extra) -> None:
         try:
@@ -546,6 +579,10 @@ class SliceWorker:
         from clonos_tpu.runtime.cluster import ClusterRunner
         group = int(tdd["group"])
         attempt = int(tdd.get("attempt", 0))
+        # Join the JobMaster's trace: every span this worker emits from
+        # here on (epochs, checkpoints, recovery phases) shares its id.
+        tp.adopt_trace(tdd)
+        tr = get_tracer()
         self._task_state(group, "DEPLOYING", attempt=attempt)
         job = _load_job(tdd["job"])
         sub, vmap, feeds, exports = job.subgraph(
@@ -559,10 +596,13 @@ class SliceWorker:
         kw = dict(tdd.get("runner_kw") or {})
         recovered = bool(tdd.get("recover"))
         if recovered:
-            runner, _report = ClusterRunner.bootstrap_standby(
-                sub, tdd["checkpoint_dir"], tdd.get("_mirror_rows") or {},
-                ignored_checkpoints=tdd.get("ignored") or (),
-                feed_readers=readers, **kw)
+            with tr.span("recovery.rebuild", group=group,
+                         attempt=attempt):
+                runner, _report = ClusterRunner.bootstrap_standby(
+                    sub, tdd["checkpoint_dir"],
+                    tdd.get("_mirror_rows") or {},
+                    ignored_checkpoints=tdd.get("ignored") or (),
+                    feed_readers=readers, **kw)
             # Live pulls resume at the replayed feed offsets.
             for nvid, r in readers.items():
                 if hasattr(r, "seek"):
@@ -587,6 +627,10 @@ class SliceWorker:
             complete_every=int(tdd.get("complete_every", 1)),
             attempt=attempt)
         self.slices[group] = sl
+        if recovered:
+            tr.event("recovery.caught_up", group=group, attempt=attempt,
+                     epoch=runner.executor.epoch_id,
+                     global_step=runner.global_step)
         self._task_state(
             group, "RUNNING", attempt=attempt,
             log_port=log_ep.address[1],
@@ -636,6 +680,8 @@ class SliceWorker:
                         "digest": sl.runner.state_digest()})
             sl.log_ep.refresh()
             progressed = True
+        if progressed:
+            self._refresh_metrics()
         return progressed
 
     def run(self, max_seconds: float = 600.0, idle_sleep: float = 0.05,
@@ -703,6 +749,15 @@ class SlotPoolScheduler:
         self._export_addr: Dict[int, Tuple[str, int]] = {}
         self._attempts: Dict[int, int] = {}
         self._deploy_clients: Dict[str, tp.ControlClient] = {}
+        # JobMaster-side latency distributions for the scheduler's own
+        # recovery phases (the worker-side phases ride heartbeats).
+        from clonos_tpu.utils import metrics as met
+        self.metrics = met.MetricRegistry()
+        g = self.metrics.group("scheduler")
+        self._m_deploy_ms = g.histogram("deploy-ms")
+        self._m_fetch_ms = g.histogram("recovery.determinant-fetch-ms")
+        self._m_redeploy_ms = g.histogram("recovery.redeploy-ms")
+        self._detected: set = set()    # workers already traced as failed
 
     # --- leadership ----------------------------------------------------------
 
@@ -785,10 +840,15 @@ class SlotPoolScheduler:
         """Stamp, send, await RUNNING, and wire mirror + exports."""
         attempt = self._attempts.get(group, -1) + 1
         self._attempts[group] = attempt
-        tdd = dict(tdd, attempt=attempt,
-                   fencing_epoch=self.election.epoch)
-        self._send_deploy(worker_id, tdd, frame)
-        st = self._wait_running(worker_id, group, attempt)
+        tdd = tp.attach_trace(dict(tdd, attempt=attempt,
+                                   fencing_epoch=self.election.epoch))
+        t0 = time.monotonic()
+        with get_tracer().span("deploy", group=group, worker=worker_id,
+                               attempt=attempt,
+                               recover=bool(tdd.get("recover"))):
+            self._send_deploy(worker_id, tdd, frame)
+            st = self._wait_running(worker_id, group, attempt)
+        self._m_deploy_ms.update((time.monotonic() - t0) * 1e3)
         info = self._worker_info(worker_id)
         host = info.get("deploy_host", "127.0.0.1")
         _ins, outs = cut_edges(self.job, tdd["vertices"])
@@ -847,7 +907,17 @@ class SlotPoolScheduler:
 
     def failed_workers(self) -> List[str]:
         placed = set(self.placements.values())
-        return [w for w in self.jm.expired() if w in placed]
+        out = [w for w in self.jm.expired() if w in placed]
+        tr = get_tracer()
+        if tr.enabled:
+            for w in out:
+                if w not in self._detected:     # once per worker death
+                    self._detected.add(w)
+                    tr.event("recovery.detect", worker=w,
+                             groups=sorted(
+                                 g for g, pw in self.placements.items()
+                                 if pw == w))
+        return out
 
     def recover_worker(self, dead_worker: str) -> Dict[int, str]:
         """A worker died: redeploy ONLY its task groups — preferring
@@ -863,21 +933,32 @@ class SlotPoolScheduler:
         with self.jm._lock:
             ignored = sorted(set(self.jm._ignored))
         moved: Dict[int, str] = {}
-        for group in lost:
-            target = self.standby.get(group)
-            if target == dead_worker or target not in self.pool.workers():
-                target = None
-            slot = self.pool.allocate(group, prefer=target,
-                                      avoid=(dead_worker,))
-            mirror = self.mirrors[group]
-            deltas = []
-            for flat in mirror.flats:
-                rows, start = mirror.rows_with_start(flat)
-                deltas.append((flat, start, np.asarray(rows, np.int32)))
-            frame = serde.encode_delta(deltas)
-            tdd = dict(self.groups[group], recover=True, ignored=ignored)
-            self._place(group, tdd, slot.worker_id, frame)
-            moved[group] = slot.worker_id
+        tr = get_tracer()
+        t0 = time.monotonic()
+        with tr.span("recovery.redeploy", worker=dead_worker,
+                     groups=lost):
+            for group in lost:
+                target = self.standby.get(group)
+                if (target == dead_worker
+                        or target not in self.pool.workers()):
+                    target = None
+                slot = self.pool.allocate(group, prefer=target,
+                                          avoid=(dead_worker,))
+                mirror = self.mirrors[group]
+                tf = time.monotonic()
+                with tr.span("recovery.determinant_fetch", group=group):
+                    deltas = []
+                    for flat in mirror.flats:
+                        rows, start = mirror.rows_with_start(flat)
+                        deltas.append(
+                            (flat, start, np.asarray(rows, np.int32)))
+                    frame = serde.encode_delta(deltas)
+                self._m_fetch_ms.update((time.monotonic() - tf) * 1e3)
+                tdd = dict(self.groups[group], recover=True,
+                           ignored=ignored)
+                self._place(group, tdd, slot.worker_id, frame)
+                moved[group] = slot.worker_id
+        self._m_redeploy_ms.update((time.monotonic() - t0) * 1e3)
         return moved
 
     def close(self) -> None:

@@ -15,10 +15,11 @@ every second) as :class:`LogOccupancyWatchdog` over the device log sizes.
 
 from __future__ import annotations
 
+import collections
 import json
 import threading
 import time
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Deque, Dict, List, Optional
 
 import numpy as np
 
@@ -50,7 +51,7 @@ class Meter:
     """Rate of events/sec over a sliding window."""
 
     def __init__(self, window_s: float = 10.0, clock=time.monotonic):
-        self._events: List[tuple] = []
+        self._events: Deque[tuple] = collections.deque()
         self._window = window_s
         self._clock = clock
 
@@ -59,7 +60,7 @@ class Meter:
         self._events.append((now, n))
         cut = now - self._window
         while self._events and self._events[0][0] < cut:
-            self._events.pop(0)
+            self._events.popleft()
 
     @property
     def rate(self) -> float:
@@ -71,13 +72,12 @@ class Meter:
 
 class Histogram:
     def __init__(self, max_samples: int = 1024):
-        self._buf: List[float] = []
-        self._max = max_samples
+        # deque(maxlen=...) evicts the oldest sample in O(1); the old
+        # list.pop(0) made every update past capacity O(max_samples)
+        self._buf: Deque[float] = collections.deque(maxlen=max_samples)
 
     def update(self, v: float) -> None:
         self._buf.append(v)
-        if len(self._buf) > self._max:
-            self._buf.pop(0)
 
     def quantile(self, q: float) -> float:
         if not self._buf:
@@ -164,10 +164,14 @@ class MetricRegistry:
         for r in self._reporters:
             r.report(snap)
 
-    def prometheus_text(self) -> str:
-        """Prometheus exposition-format dump of scalar metrics."""
+    def prometheus_text(self, snapshot: Optional[Dict[str, Any]] = None
+                        ) -> str:
+        """Prometheus exposition-format dump of scalar metrics (pass a
+        pre-merged ``snapshot`` to include e.g. cluster-wide values)."""
         lines = []
-        for name, v in sorted(self.snapshot().items()):
+        if snapshot is None:
+            snapshot = self.snapshot()
+        for name, v in sorted(snapshot.items()):
             metric = name.replace(".", "_").replace("-", "_")
             if isinstance(v, (int, float)):
                 lines.append(f"{metric} {v}")
@@ -197,11 +201,24 @@ class JsonLinesReporter(Reporter):
     def __init__(self, path: str, clock=time.time):
         self._path = path
         self._clock = clock
+        self._file = None
+        self._lock = threading.Lock()
 
     def report(self, snapshot: Dict[str, Any]) -> None:
         rec = {"ts": self._clock(), **snapshot}
-        with open(self._path, "a") as f:
-            f.write(json.dumps(rec, default=str) + "\n")
+        with self._lock:
+            # one append-mode handle for the reporter's lifetime;
+            # flush per record so readers (and crashes) see every line
+            if self._file is None:
+                self._file = open(self._path, "a")
+            self._file.write(json.dumps(rec, default=str) + "\n")
+            self._file.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            if self._file is not None:
+                self._file.close()
+                self._file = None
 
 
 class ReporterThread:
@@ -225,6 +242,10 @@ class ReporterThread:
         self._stop.set()
         if self._thread:
             self._thread.join(timeout=5)
+        for r in self._registry._reporters:
+            close = getattr(r, "close", None)
+            if close is not None:
+                close()
 
 
 class LogOccupancyWatchdog:
@@ -263,25 +284,49 @@ class MetricsEndpoint:
     """Serves the registry over HTTP (reference WebMonitorEndpoint /
     rest handlers, WebMonitorEndpoint.java:148 — scoped to the two
     surfaces a headless job needs): ``/metrics`` in Prometheus
-    exposition format, ``/metrics.json`` as a JSON snapshot. Runs on a
-    daemon thread; scrape-only (no job control), so it touches no
-    device state."""
+    exposition format, ``/metrics.json`` as a JSON snapshot, and
+    ``/trace`` as the tracer's flight-recorder ring rendered as Chrome
+    trace JSON. Runs on a daemon thread; scrape-only (no job control),
+    so it touches no device state.
+
+    ``extra`` is a zero-arg callable returning additional name→value
+    pairs merged into both metric views — the JobMaster passes its
+    aggregated per-worker heartbeat snapshots here so one scrape covers
+    the whole cluster. ``tracer`` (any object with ``records()``)
+    backs ``/trace``; without one the path 404s."""
 
     def __init__(self, registry: MetricRegistry, host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0,
+                 extra: Optional[Callable[[], Dict[str, Any]]] = None,
+                 tracer=None):
         import http.server
         import json as _json
         import threading
 
         reg = registry
 
+        def merged():
+            snap = reg.snapshot()
+            if extra is not None:
+                try:
+                    snap.update(extra())
+                except Exception as e:
+                    snap["extra-error"] = repr(e)
+            return snap
+
         class H(http.server.BaseHTTPRequestHandler):
             def do_GET(self):
                 if self.path.rstrip("/") == "/metrics":
-                    body = reg.prometheus_text().encode()
+                    body = reg.prometheus_text(merged()).encode()
                     ctype = "text/plain; version=0.0.4"
                 elif self.path.rstrip("/") == "/metrics.json":
-                    body = _json.dumps(reg.snapshot()).encode()
+                    body = _json.dumps(merged(), default=str).encode()
+                    ctype = "application/json"
+                elif self.path.rstrip("/") == "/trace" and \
+                        tracer is not None:
+                    from ..obs import chrome as _chrome
+                    body = _json.dumps(
+                        _chrome.to_chrome(tracer.records())).encode()
                     ctype = "application/json"
                 else:
                     self.send_error(404)

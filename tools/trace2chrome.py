@@ -1,0 +1,59 @@
+#!/usr/bin/env python
+"""Convert flight-recorder JSON-lines to Chrome trace_event JSON.
+
+Standalone converter over clonos_tpu.obs (the CLI's ``clonos_tpu trace
+--chrome`` wraps the same functions): reads one or more
+``trace-*.jsonl`` files — typically the JobMaster's and every worker's
+files from one run, which share a trace id via the control-wire
+propagation — validates the result, and writes a file loadable in
+Perfetto (https://ui.perfetto.dev) or Chrome ``about:tracing``.
+
+    python tools/trace2chrome.py traces/trace-*.jsonl -o out.json
+    python tools/trace2chrome.py traces/trace-*.jsonl --check
+
+``--check`` validates without writing (the tests' validity gate).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+# Runnable by path from anywhere: the repo root (this file's parent's
+# parent) hosts the clonos_tpu package.
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("files", nargs="+", help="trace-*.jsonl inputs")
+    ap.add_argument("-o", "--out", default=None,
+                    help="output Chrome trace JSON path")
+    ap.add_argument("--trace-id", default=None,
+                    help="keep only records of this trace id")
+    ap.add_argument("--check", action="store_true",
+                    help="validate only; write nothing")
+    args = ap.parse_args(argv)
+    if not args.check and args.out is None:
+        ap.error("either --out or --check is required")
+
+    from clonos_tpu import obs
+
+    records = obs.load_jsonl(args.files)
+    doc = obs.to_chrome(records, trace_id=args.trace_id)
+    n = obs.validate_chrome(doc)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(doc, f)
+    traces = sorted({r.get("trace") for r in records})
+    print(json.dumps({"records": len(records), "events": n,
+                      "traces": traces, "out": args.out,
+                      "valid": True}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
