@@ -79,6 +79,8 @@ class EpochTracker:
     record_count: int = 0
     _epoch_listeners: List[Callable[[int], None]] = dataclasses.field(default_factory=list)
     _checkpoint_listeners: List[Callable[[int], None]] = dataclasses.field(default_factory=list)
+    # (epoch_id, sealed digest) listeners — the audit plane's fan-out
+    _seal_listeners: List[Callable[[int, object], None]] = dataclasses.field(default_factory=list)
     # sorted list of (epoch, target_record_count, seq, determinant, callback)
     _targets: List[Tuple[int, int, int, Determinant, Callable[[Determinant], None]]] = (
         dataclasses.field(default_factory=list))
@@ -102,6 +104,18 @@ class EpochTracker:
     def notify_checkpoint_complete(self, checkpoint_id: int) -> None:
         for fn in self._checkpoint_listeners:
             fn(checkpoint_id)
+
+    def subscribe_epoch_seal(self,
+                             fn: Callable[[int, object], None]) -> None:
+        """Audit plane: ``fn(epoch_id, digest)`` fires when an epoch's
+        audit digest is sealed at its barrier (obs/audit.py) — BEFORE the
+        checkpoint completes, so subscribers (wire shippers, tests) see
+        the digest while the epoch's logs are still resident."""
+        self._seal_listeners.append(fn)
+
+    def notify_epoch_sealed(self, epoch_id: int, digest: object) -> None:
+        for fn in self._seal_listeners:
+            fn(epoch_id, digest)
 
     def set_record_count_target(
         self, target: int, det: Determinant,

@@ -57,6 +57,78 @@ class RecoveryError(RuntimeError):
     pass
 
 
+class AuditDivergenceError(RecoveryError):
+    """A replayed epoch's recomputed audit digest does not match the
+    sealed ledger entry — the exactly-once replay contract is violated
+    (raised only under ``observability.audit.on-divergence = abort``)."""
+
+
+class AuditValidator:
+    """Recovery-time half of the epoch audit ledger (obs/audit.py).
+
+    After the causal replay has patched the failed subtasks back into the
+    live carry, the validator recomputes each replayed epoch's digest
+    from the SAME extraction path the live seal used
+    (``LocalExecutor.epoch_window`` + ``digest_epoch_window``) and
+    compares it against the persisted ledger — turning "replay is
+    bit-identical" from a test-time hope into a runtime invariant. Every
+    epoch emits a ``recovery.audit.match`` / ``recovery.audit.divergence``
+    / ``recovery.audit.missing`` instant into the active recovery trace;
+    the first divergence names the epoch and channel (which subtask's
+    determinant log or which vertex's output ring went off-script) and,
+    under the ``abort`` policy, raises :class:`AuditDivergenceError`.
+    """
+
+    def __init__(self, executor, ledger_entries: Sequence[dict],
+                 on_divergence: str = "warn"):
+        self.executor = executor
+        # last-wins per epoch: a rebuilt runner appends fresh seals for
+        # post-recovery epochs to the same durable ledger
+        self.ledger: Dict[int, dict] = {
+            int(e["epoch"]): e for e in ledger_entries}
+        self.on_divergence = on_divergence
+        #: running totals — still accurate when the abort policy throws
+        #: mid-validation (the caller's metrics read these, not the
+        #: return value)
+        self.stats: Dict[str, int] = {"match": 0, "divergence": 0,
+                                      "missing": 0}
+
+    def validate(self, epochs: Sequence[int]) -> Dict[str, int]:
+        """Validate the given replayed (closed) epochs against the
+        ledger. Returns ``{"match": n, "divergence": n, "missing": n}``;
+        raises under the abort policy after emitting the divergence
+        instant (the flight recorder keeps the evidence either way)."""
+        from clonos_tpu.obs import audit as _audit
+        from clonos_tpu.obs.digest import EpochDigest, diff as _diff
+        tr = _get_tracer()
+        stats = self.stats
+        for e in epochs:
+            e = int(e)
+            recomputed = _audit.digest_epoch_window(
+                e, self.executor.epoch_window(e))
+            entry = self.ledger.get(e)
+            if entry is None:
+                stats["missing"] += 1
+                tr.event("recovery.audit.missing", epoch=e)
+                continue
+            d = _diff(EpochDigest.from_entry(entry), recomputed)
+            if d is None:
+                stats["match"] += 1
+                tr.event("recovery.audit.match", epoch=e,
+                         channels=len(recomputed.channels),
+                         records=recomputed.record_count())
+            else:
+                stats["divergence"] += 1
+                channel, reason = d
+                tr.event("recovery.audit.divergence", epoch=e,
+                         channel=channel, reason=reason)
+                if self.on_divergence == "abort":
+                    raise AuditDivergenceError(
+                        f"epoch {e} channel {channel}: {reason} — replay "
+                        f"did not reproduce the original execution")
+        return stats
+
+
 @dataclasses.dataclass
 class ReplayPlan:
     """Everything a standby needs to replay one failed subtask."""

@@ -12,6 +12,8 @@ Usage:
     python -m clonos_tpu info <module:function>
     python -m clonos_tpu bench
     python -m clonos_tpu dryrun [--devices N]
+    python -m clonos_tpu audit <checkpoint-dir> [--diff DIR2] [--json]
+    python -m clonos_tpu dissect [--trials N]
 """
 
 from __future__ import annotations
@@ -203,6 +205,179 @@ def cmd_slotworker(args) -> int:
     return 0
 
 
+def _find_ledgers(root):
+    """Ledger files under ``root``: the path itself (file or dir with
+    ledger.jsonl) or per-group ``g*/ledger.jsonl`` subdirs (slot-pool
+    layout). Returns [(label, entries)] sorted by label."""
+    import glob
+    import os
+    from clonos_tpu.runtime.checkpoint import read_ledger_file
+
+    if os.path.isfile(root):
+        return [(os.path.basename(root), read_ledger_file(root))]
+    direct = os.path.join(root, "ledger.jsonl")
+    if os.path.exists(direct):
+        return [("ledger.jsonl", read_ledger_file(direct))]
+    out = []
+    for p in sorted(glob.glob(os.path.join(root, "*", "ledger.jsonl"))):
+        out.append((os.path.join(os.path.basename(os.path.dirname(p)),
+                                 "ledger.jsonl"), read_ledger_file(p)))
+    return out
+
+
+def cmd_audit(args) -> int:
+    """Print or diff a job's epoch audit ledger (``clonos_tpu audit``):
+    the per-epoch digests obs/audit.py sealed at each checkpoint
+    barrier. ``--diff`` compares against a second run's ledger and
+    exits 1 on the first divergence (epoch + channel named)."""
+    from clonos_tpu.obs import digest as _digest
+
+    ledgers = _find_ledgers(args.dir)
+    if not ledgers:
+        print(f"no ledger.jsonl under {args.dir}", file=sys.stderr)
+        return 1
+    if args.diff:
+        other = dict(_find_ledgers(args.diff))
+        problems = []
+        for label, entries in ledgers:
+            problems += [f"{label}: {line}" for line in
+                         _digest.diff_ledgers(entries,
+                                              other.get(label, []))]
+        for line in problems:
+            print(line)
+        if not problems:
+            print(f"ledgers match ({sum(len(e) for _, e in ledgers)} "
+                  f"entries)")
+        return 1 if problems else 0
+    if args.json:
+        print(json.dumps({label: entries for label, entries in ledgers},
+                         indent=2))
+        return 0
+    for label, entries in ledgers:
+        # last-wins per epoch: a rebuilt runner re-seals replayed epochs
+        by_epoch = {e["epoch"]: e for e in entries}
+        print(f"# {label} — {len(by_epoch)} epochs "
+              f"({len(entries)} entries)")
+        for ep in sorted(by_epoch):
+            e = by_epoch[ep]
+            dets = " ".join(f"{k}={v}" for k, v in
+                            sorted((e.get("det_counts") or {}).items()))
+            print(f"epoch {ep:>4}  records {e.get('records', 0):>8}  "
+                  f"channels {len(e.get('channels') or {}):>3}  "
+                  f"combined {e.get('combined', '?')}  {dets}")
+    return 0
+
+
+def cmd_dissect(args) -> int:
+    """Dissect the warm replay at full bench shapes: what the min-of-N
+    ``replayer.replay(plan)`` wall actually spends — dispatch-chain
+    compute (amortized over a chained loop, tunnel RTT excluded) vs the
+    single d2h sync. Optimization must target whichever dominates.
+    (Absorbed from tools/replay_dissect.py.)"""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import bench
+    from clonos_tpu.runtime.cluster import ClusterRunner
+    from clonos_tpu.runtime.executor import DETS_PER_STEP
+    from clonos_tpu.utils.devsync import device_sync
+
+    SPE = bench.STEPS_PER_EPOCH
+    job = bench.build_job()
+    need = bench.FILL_EPOCHS * SPE * DETS_PER_STEP
+    cap = 1 << need.bit_length()
+    runner = ClusterRunner(job, steps_per_epoch=SPE, log_capacity=cap,
+                           max_epochs=16,
+                           inflight_ring_steps=1 << max(
+                               bench.FILL_EPOCHS * SPE, 2).bit_length(),
+                           recovery_block_steps=8192, block_steps=1024,
+                           seed=7)
+    t0 = time.monotonic()
+    runner.run_epoch(complete_checkpoint=True)
+    device_sync(runner.executor.carry)
+    print("epoch0:", round(time.monotonic() - t0, 1), "s", flush=True)
+    t0 = time.monotonic()
+    for _ in range(bench.FILL_EPOCHS):
+        runner.run_epoch(complete_checkpoint=False)
+    device_sync(runner.executor.carry)
+    print("fill:", round(time.monotonic() - t0, 1), "s", flush=True)
+
+    failed = bench.PAR + 1
+    runner.inject_failure([failed])
+    t0 = time.monotonic()
+    report = runner.recover()
+    device_sync(runner.executor.carry)
+    print("cold recover:", round(time.monotonic() - t0, 1), "s",
+          {k: round(v, 1) for k, v in report.phase_ms.items()}, flush=True)
+
+    mgr = report.managers[0]
+    replayer = mgr.replayer
+    plan = mgr.plan
+
+    # (a) bench's exact warm-replay measurement
+    for trial in range(args.trials):
+        t1 = time.monotonic()
+        result = replayer.replay(plan)
+        device_sync(result.emit_counts)
+        print(f"warm replay #{trial}: "
+              f"{(time.monotonic() - t1) * 1e3:.1f}ms  phases:",
+              {k: round(v, 1) for k, v in result.phase_ms.items()},
+              flush=True)
+
+    # (b) amortized compute of the core block program alone (tunnel RTT
+    # excluded): chain N iterations inside one jit, one sync at the end.
+    dev = plan.det_device is not None
+    print("clean device path:", dev, "n_steps:", plan.n_steps, flush=True)
+    if dev:
+        t_dev, r_dev, _exp = plan.det_device
+        chunk = plan.input_steps[0] if isinstance(plan.input_steps, list) \
+            else plan.input_steps
+        state0 = jax.tree_util.tree_map(
+            lambda x: x[plan.subtask][None], plan.checkpoint_op_state)
+        sub = jnp.asarray(plan.subtask, jnp.int32)
+        N = 10
+        jb = replayer._jit_block
+
+        def chained():
+            acc = jnp.zeros((), jnp.int32)
+            for _ in range(N):
+                st, out, counts, acc = jb(
+                    state0, chunk, t_dev[:replayer.block_steps],
+                    r_dev[:replayer.block_steps], sub, acc)
+            return counts
+        r = chained()
+        np.asarray(r.ravel()[0])
+        ts = []
+        for _ in range(3):
+            t1 = time.monotonic()
+            r = chained()
+            np.asarray(r.ravel()[0])
+            ts.append((time.monotonic() - t1) * 1e3)
+        print(f"block program amortized: {min(ts) / N:.2f}ms per call "
+              f"(chain of {N}: {min(ts):.1f}ms)", flush=True)
+
+        # (c) tail ops: tslice + concat cost
+        def tail():
+            acc = jnp.zeros((), jnp.int32)
+            st, out, counts, acc = jb(state0, chunk,
+                                      t_dev[:replayer.block_steps],
+                                      r_dev[:replayer.block_steps], sub, acc)
+            packed = jnp.concatenate(
+                [counts, acc.reshape(1), _exp[:plan.n_steps]], axis=0)
+            return packed
+        p = tail()
+        np.asarray(p.ravel()[0])
+        ts = []
+        for _ in range(5):
+            t1 = time.monotonic()
+            p = tail()
+            np.asarray(p.ravel()[0])
+            ts.append((time.monotonic() - t1) * 1e3)
+        print(f"block+concat+sync single: min={min(ts):.1f}ms "
+              f"p50={sorted(ts)[2]:.1f}ms", flush=True)
+    return 0
+
+
 def cmd_trace(args) -> int:
     """Dump / convert recorded trace files (``clonos_tpu trace``):
     summary by default, Chrome trace_event JSON with ``--chrome`` (the
@@ -332,6 +507,25 @@ def main(argv=None) -> int:
                     help="also print the dominant trace's ordered "
                          "event timeline")
     pt.set_defaults(fn=cmd_trace)
+
+    pa = sub.add_parser("audit", help="print or diff a job's epoch "
+                                      "audit ledger")
+    pa.add_argument("dir", help="checkpoint dir (or slot-pool "
+                                "checkpoint root with g*/ subdirs, or a "
+                                "ledger.jsonl file)")
+    pa.add_argument("--diff", default=None, metavar="DIR",
+                    help="second run's checkpoint dir; exit 1 naming "
+                         "the first diverging epoch and channel per "
+                         "group")
+    pa.add_argument("--json", action="store_true",
+                    help="dump raw ledger entries as JSON")
+    pa.set_defaults(fn=cmd_audit)
+
+    px = sub.add_parser("dissect", help="dissect warm-replay wall time "
+                                        "at bench shapes")
+    px.add_argument("--trials", type=int, default=5,
+                    help="warm replay trials to time")
+    px.set_defaults(fn=cmd_dissect)
 
     args = p.parse_args(argv)
     return args.fn(args)

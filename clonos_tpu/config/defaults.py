@@ -143,3 +143,20 @@ TRACE_BUFFER_EVENTS = ConfigOption(
     validator=lambda v: v > 0,
     description="Flight-recorder ring size: most recent trace records kept "
                 "in memory and served on the metrics endpoint's /trace.")
+
+AUDIT_ENABLED = ConfigOption(
+    "observability.audit.enabled", False,
+    description="Seal a per-epoch audit digest at every checkpoint barrier, "
+                "persist the epoch ledger next to the checkpoints, and "
+                "validate replayed epochs against it during recovery. Off = "
+                "the NullAuditor: no digest reads, no ledger writes, no "
+                "wire fields.")
+
+AUDIT_ON_DIVERGENCE = ConfigOption(
+    "observability.audit.on-divergence", "warn",
+    validator=lambda v: v in ("warn", "abort"),
+    description="What a replay-divergence audit finding does: 'warn' emits "
+                "the recovery.audit.divergence instant and counts it; "
+                "'abort' additionally fails the recovery "
+                "(AuditDivergenceError) before the job resumes on "
+                "non-reproduced state.")

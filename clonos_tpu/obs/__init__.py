@@ -1,18 +1,28 @@
-"""Observability: distributed tracing + recovery flight recorder.
+"""Observability: distributed tracing + recovery flight recorder +
+exactly-once auditing.
 
-See obs/trace.py for the design. Typical use::
+See obs/trace.py for the tracing design, obs/audit.py + obs/digest.py
+for the epoch audit ledger. Typical use::
 
     from clonos_tpu import obs
 
     obs.configure("jm", path="traces/trace-jm.jsonl")
     with obs.get_tracer().span("recovery.redeploy", worker="b"):
         ...
+
+    obs.configure_audit(on_divergence="abort")   # audit every runner
 """
 
 from .trace import (NullTracer, Tracer, configure, get_tracer,  # noqa: F401
                     reset)
 from .chrome import (load_jsonl, summarize, to_chrome,  # noqa: F401
                      validate_chrome)
+from .digest import EpochDigest, diff, diff_ledgers  # noqa: F401
+from .audit import (Auditor, NullAuditor, configure_audit,  # noqa: F401
+                    digest_epoch_window, get_auditor, reset_audit)
 
 __all__ = ["Tracer", "NullTracer", "get_tracer", "configure", "reset",
-           "load_jsonl", "to_chrome", "validate_chrome", "summarize"]
+           "load_jsonl", "to_chrome", "validate_chrome", "summarize",
+           "EpochDigest", "diff", "diff_ledgers",
+           "Auditor", "NullAuditor", "get_auditor", "configure_audit",
+           "reset_audit", "digest_epoch_window"]

@@ -1,0 +1,150 @@
+"""Exactly-once auditing: per-epoch digest seal + process-global switch.
+
+Mirrors obs/trace.py's shape exactly: a zero-overhead :class:`NullAuditor`
+is the process default (``enabled`` is a class attribute, so the hot
+``if auditor.enabled`` check costs one attribute load and audit-off runs
+do no per-record host work and add no wire fields), and
+:func:`configure` swaps in a live :class:`Auditor` under a lock.
+
+The Auditor itself is thin: policy (warn vs abort on divergence) plus an
+in-memory ledger of sealed digests. Digest COMPUTATION lives in
+:func:`digest_epoch_window`, fed by ``LocalExecutor.epoch_window`` — the
+single extraction path shared by the live seal (ClusterRunner.run_epoch)
+and the recovery-time recompute (causal/recovery.AuditValidator), which
+is what makes the chain's chunk boundaries identical on both sides.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+from clonos_tpu.obs.digest import EpochDigest
+
+#: accepted divergence policies (config validator + CLI share this)
+DIVERGENCE_POLICIES = ("warn", "abort")
+
+
+class NullAuditor:
+    """Audit disabled: every operation is a no-op. The default."""
+
+    enabled = False
+    on_divergence = "warn"
+
+    def seal(self, digest: EpochDigest) -> None:
+        pass
+
+    def ledger(self) -> List[dict]:
+        return []
+
+    @property
+    def last_epoch(self) -> int:
+        return -1
+
+    @property
+    def epochs_sealed(self) -> int:
+        return 0
+
+    def close(self) -> None:
+        pass
+
+
+class Auditor(NullAuditor):
+    """Live auditor: records sealed digests and carries the divergence
+    policy. One per runner (sealing is a main-thread fence action), but
+    also installable process-globally via :func:`configure` so remote
+    workers inherit the JobMaster's audit stance (transport.adopt_audit)."""
+
+    enabled = True
+
+    def __init__(self, on_divergence: str = "warn"):
+        if on_divergence not in DIVERGENCE_POLICIES:
+            raise ValueError(
+                f"on_divergence must be one of {DIVERGENCE_POLICIES}, "
+                f"got {on_divergence!r}")
+        self.on_divergence = on_divergence
+        self._sealed: Dict[int, dict] = {}
+        self._lock = threading.Lock()
+
+    def seal(self, digest: EpochDigest) -> None:
+        with self._lock:
+            self._sealed[digest.epoch] = digest.to_entry()
+
+    def ledger(self) -> List[dict]:
+        with self._lock:
+            return [self._sealed[e] for e in sorted(self._sealed)]
+
+    @property
+    def last_epoch(self) -> int:
+        with self._lock:
+            return max(self._sealed) if self._sealed else -1
+
+    @property
+    def epochs_sealed(self) -> int:
+        with self._lock:
+            return len(self._sealed)
+
+
+# --- digest extraction -------------------------------------------------------
+
+
+def digest_epoch_window(epoch: int, window: dict) -> EpochDigest:
+    """Fold one epoch's causal surface (``LocalExecutor.epoch_window``
+    output) into an :class:`EpochDigest`.
+
+    Chunk-boundary contract (chain folds are order-sensitive): each
+    ``log/<flat>`` channel is folded as ONE chunk — the epoch's
+    determinant-row window in log order; each ``ring/v<vid>`` channel is
+    folded ONE chunk PER STEP — the step's valid (key, value, timestamp)
+    records flattened in (lane, slot) order. Live seal and recovery
+    recompute both call this function, so the boundaries always agree.
+    """
+    import numpy as np
+    from clonos_tpu.causal import determinant as det
+
+    dg = EpochDigest(epoch)
+    for flat, rows in sorted(window.get("logs", {}).items()):
+        rows = np.ascontiguousarray(rows, np.int32)
+        dg.fold(f"log/{flat}", det.to_bytes(rows), count=rows.shape[0])
+        if rows.shape[0]:
+            counts = np.bincount(rows[:, det.LANE_TAG],
+                                 minlength=det.NUM_TAGS)
+            for tag in range(det.NUM_TAGS):
+                dg.count_det(det.TAG_NAMES[tag], int(counts[tag]))
+    for vid, steps in sorted(window.get("rings", {}).items()):
+        chan = f"ring/v{vid}"
+        for keys, values, timestamps in steps:
+            data = (np.ascontiguousarray(keys, np.int32).tobytes()
+                    + np.ascontiguousarray(values, np.int32).tobytes()
+                    + np.ascontiguousarray(timestamps, np.int32).tobytes())
+            dg.fold(chan, data, count=int(np.asarray(keys).shape[0]))
+    return dg
+
+
+# --- process-global auditor (obs/trace.py convention) ------------------------
+
+_global_auditor: NullAuditor = NullAuditor()
+_global_lock = threading.Lock()
+
+
+def get_auditor() -> NullAuditor:
+    return _global_auditor
+
+
+def configure_audit(on_divergence: str = "warn") -> Auditor:
+    """Install a process-global live auditor (the default a ClusterRunner
+    built with ``audit=None`` inherits)."""
+    global _global_auditor
+    with _global_lock:
+        old = _global_auditor
+        _global_auditor = Auditor(on_divergence=on_divergence)
+        old.close()
+        return _global_auditor
+
+
+def reset_audit() -> None:
+    global _global_auditor
+    with _global_lock:
+        old = _global_auditor
+        _global_auditor = NullAuditor()
+        old.close()

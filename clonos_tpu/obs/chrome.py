@@ -26,16 +26,28 @@ _KNOWN_PH = {"X", "i", "B", "E", "M", "C"}
 
 def load_jsonl(paths) -> List[dict]:
     """Read tracer records from one path or a list of paths (blank
-    lines skipped), sorted by timestamp."""
+    lines skipped), sorted by timestamp.
+
+    A SIGKILLed process leaves its final JSONL line torn mid-record;
+    that truncated tail is expected debris, not corruption, so it is
+    dropped silently. A decode failure on any EARLIER line still
+    raises — that means the file really is damaged."""
     if isinstance(paths, (str, bytes)):
         paths = [paths]
     records: List[dict] = []
     for path in paths:
         with open(path) as f:
-            for line in f:
-                line = line.strip()
-                if line:
-                    records.append(json.loads(line))
+            lines = [ln.strip() for ln in f]
+        nonempty = [(i, ln) for i, ln in enumerate(lines) if ln]
+        for pos, (i, ln) in enumerate(nonempty):
+            try:
+                records.append(json.loads(ln))
+            except json.JSONDecodeError:
+                if pos == len(nonempty) - 1:
+                    break
+                raise ValueError(
+                    f"{path}:{i + 1}: undecodable trace record "
+                    f"(not a truncated tail)")
     records.sort(key=lambda r: r.get("ts", 0.0))
     return records
 

@@ -106,6 +106,31 @@ def adopt_trace(header: Dict[str, Any]) -> None:
         tr.adopt(header.get("trace"))
 
 
+# --- audit-context convention ------------------------------------------------
+# Same shape as the trace convention, for the exactly-once audit plane
+# (obs/audit.py): a JobMaster with auditing configured stamps its stance
+# on DEPLOY headers so every worker runner seals and validates epoch
+# digests under the same policy. A disabled auditor attaches NOTHING —
+# audit-off wire bytes are identical to a pre-audit build.
+
+def attach_audit(header: Dict[str, Any]) -> Dict[str, Any]:
+    """Add the process auditor's stance to a JSON header (in place)."""
+    from clonos_tpu.obs import get_auditor
+    a = get_auditor()
+    if a.enabled:
+        header["audit"] = {"on_divergence": a.on_divergence}
+    return header
+
+
+def adopt_audit(header: Dict[str, Any]) -> None:
+    """Enable process-wide auditing per a received header's ``audit``
+    field (no-op without one; runners built AFTER adoption inherit)."""
+    from clonos_tpu.obs import configure_audit, get_auditor
+    ctx = header.get("audit")
+    if ctx and not get_auditor().enabled:
+        configure_audit(on_divergence=ctx.get("on_divergence", "warn"))
+
+
 class ControlServer:
     """Threaded request/response endpoint. ``handler(mtype, payload) ->
     (mtype, payload)`` runs per request; one TCP connection may carry many

@@ -88,6 +88,21 @@ class CheckpointStorage:
         return sorted(c for c in self.list_ids()
                       if os.path.exists(self._path(c) + ".done"))
 
+    # --- epoch audit ledger (obs/audit.py) -----------------------------------
+    # Default: in-memory. Ledger entries are tiny (per-epoch digest
+    # summaries) and, unlike snapshots, are NEVER deleted by retention —
+    # a later recovery must be able to validate any epoch at/after the
+    # restore point, and cross-run diffing wants the whole history
+    # (compaction is a ROADMAP open item).
+
+    def write_ledger(self, entry: dict) -> None:
+        if not hasattr(self, "_ledger"):
+            self._ledger: List[dict] = []
+        self._ledger.append(dict(entry))
+
+    def read_ledger(self) -> List[dict]:
+        return [dict(e) for e in getattr(self, "_ledger", [])]
+
 
 class InMemoryCheckpointStorage(CheckpointStorage):
     wants_host = False
@@ -151,6 +166,44 @@ class FileCheckpointStorage(CheckpointStorage):
             if fn.startswith("chk_") and fn.endswith(".pkl"):
                 out.append(int(fn[4:-4]))
         return sorted(out)
+
+    def ledger_path(self) -> str:
+        return os.path.join(self.root, "ledger.jsonl")
+
+    def write_ledger(self, entry: dict) -> None:
+        """Durable append, one JSON line per sealed epoch, flushed per
+        entry so a SIGKILLed worker loses at most the line being written
+        (readers tolerate the truncated tail)."""
+        import json
+        with open(self.ledger_path(), "a") as f:
+            f.write(json.dumps(entry, sort_keys=True) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+
+    def read_ledger(self) -> List[dict]:
+        return read_ledger_file(self.ledger_path())
+
+
+def read_ledger_file(path: str) -> List[dict]:
+    """Read a ledger.jsonl, tolerating a torn final line (SIGKILL mid
+    append); a decode failure on any earlier line still raises. Shared
+    by FileCheckpointStorage and ``clonos_tpu audit``."""
+    import json
+    if not os.path.exists(path):
+        return []
+    out: List[dict] = []
+    with open(path) as f:
+        lines = f.read().splitlines()
+    for i, line in enumerate(lines):
+        if not line.strip():
+            continue
+        try:
+            out.append(json.loads(line))
+        except json.JSONDecodeError:
+            if i == len(lines) - 1:
+                break        # SIGKILL artifact: torn final append
+            raise
+    return out
 
 
 def carry_to_host(carry) -> Any:
@@ -314,6 +367,23 @@ class CheckpointCoordinator:
         for t in self._async_threads:
             t.join()
         self._async_threads.clear()
+
+    # --- epoch audit ledger --------------------------------------------------
+
+    def record_ledger(self, entry: dict) -> None:
+        """Persist one sealed epoch digest next to the checkpoints (the
+        JobMaster-side epoch ledger; obs/audit.py). Runs at trigger time
+        — a checkpoint that later completes certifies the epoch the
+        entry describes, and entries survive snapshot retention."""
+        with self._writer_lock:
+            self.storage.write_ledger(entry)
+
+    def read_ledger(self) -> List[dict]:
+        """All persisted ledger entries in append order. Duplicate
+        epochs (a rebuilt runner re-sealing after replay) resolve
+        last-wins at the consumer."""
+        with self._writer_lock:
+            return self.storage.read_ledger()
 
     # --- failure-path hooks --------------------------------------------------
 

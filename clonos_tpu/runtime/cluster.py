@@ -192,6 +192,8 @@ class ClusterRunner:
                  prewarm: bool = False,
                  recovery_block_steps: Optional[int] = None,
                  latency_marker_every: Optional[int] = None,
+                 audit: Optional[bool] = None,
+                 audit_on_divergence: Optional[str] = None,
                  **executor_kw):
         self.job = job
         self.executor = LocalExecutor(job, steps_per_epoch=steps_per_epoch,
@@ -257,6 +259,31 @@ class ClusterRunner:
             lambda cid: self._m_ckpt_latency_ms.update(
                 self.coordinator.completion_latency_s.get(cid, 0.0) * 1e3))
         self._mgroup = g
+        # Exactly-once audit plane (obs/audit.py): ``audit=None``
+        # inherits the process-global stance (set by config/CLI or
+        # adopted from the JobMaster's DEPLOY via transport.adopt_audit);
+        # the default is the zero-overhead NullAuditor — no digest reads,
+        # no ledger writes, no wire fields.
+        from clonos_tpu.obs import audit as _audit_mod
+        if audit is None:
+            audit = _audit_mod.get_auditor().enabled
+        if audit:
+            self.auditor: _audit_mod.NullAuditor = _audit_mod.Auditor(
+                on_divergence=(audit_on_divergence
+                               or _audit_mod.get_auditor().on_divergence))
+        else:
+            self.auditor = _audit_mod.NullAuditor()
+        self._m_audit_sealed = g.counter("audit.epochs-sealed")
+        self._m_audit_matches = g.counter("audit.epochs-validated")
+        self._m_audit_div = g.counter("audit.divergences")
+        g.gauge("audit.enabled", lambda: int(self.auditor.enabled))
+        g.gauge("audit.last-sealed-epoch", lambda: self.auditor.last_epoch)
+        # Live exactly-once health: how hard the in-flight rings are
+        # holding un-truncated history (backpressure proxy — rings only
+        # grow when checkpoints lag), and how many supersteps a failure
+        # RIGHT NOW would replay (the recovery-cost exposure).
+        g.gauge("backpressure.inflight-occupancy", self._inflight_occupancy)
+        g.gauge("recovery.replay-lag-steps", self._replay_lag_steps)
         self.watchdog = met.LogOccupancyWatchdog(self.executor, g)
         #: compiled recovery programs, keyed by (kind, params) — populated
         #: lazily and by prewarm_recovery() (warm standby: no XLA compile
@@ -345,6 +372,29 @@ class ClusterRunner:
             if b is not None:
                 tl.absorb(epoch, np.asarray(b.keys), np.asarray(b.values),
                           np.asarray(b.timestamps), np.asarray(b.valid))
+
+    # --- live health gauges (heartbeat-piggybacked; runtime/remote.py) -------
+
+    def _inflight_occupancy(self) -> float:
+        """Fraction of the in-flight rings' capacity holding
+        un-truncated steps — the host-mirror backpressure proxy (rings
+        retain exactly the steps a failure would need to re-route; a
+        rising value means checkpoint completion is lagging the fences)."""
+        if not self.executor.carry.out_rings:
+            return 0.0
+        cap = self.executor.compiled.inflight_ring_steps
+        held = self.global_step - self._ring_tail_mirror
+        return min(max(held, 0) / cap, 1.0)
+
+    def _replay_lag_steps(self) -> int:
+        """Supersteps a failure occurring NOW would replay (distance from
+        the latest completed checkpoint's fence) — the live recovery-cost
+        exposure."""
+        ck = self.standbys.latest
+        if ck is None:
+            return self.global_step
+        f = self._fence_step.get(ck.checkpoint_id + 1)
+        return self.global_step - f if f is not None else 0
 
     # --- compiled recovery programs ------------------------------------------
 
@@ -673,6 +723,9 @@ class ClusterRunner:
             kw["spill_policy"] = cfg.get(D.INFLIGHT_SPILL_POLICY)
         if cfg.contains(D.CHECKPOINT_DIR):
             kw["checkpoint_dir"] = cfg.get(D.CHECKPOINT_DIR)
+        if cfg.get(D.AUDIT_ENABLED):
+            kw["audit"] = True
+            kw["audit_on_divergence"] = cfg.get(D.AUDIT_ON_DIVERGENCE)
         kw.update(overrides)
         runner = cls(job, **kw)
         runner.coordinator.backoff_multiplier = cfg.get(
@@ -1097,6 +1150,21 @@ class ClusterRunner:
             self.epoch_tracker.start_new_epoch(self.executor.epoch_id)
             if self.latency is not None:
                 self.latency.observe()
+            # Audit seal at the fence (obs/audit.py): digest the closed
+            # epoch's causal surface while its log/ring windows are
+            # still resident (completion below truncates them), persist
+            # the ledger entry next to the checkpoint, and fan out on
+            # the epoch tracker's seal bus. The SOURCE_CHECKPOINT
+            # appends after the snapshot land past this epoch's window
+            # end, so the seal is fence-exact.
+            if self.auditor.enabled:
+                from clonos_tpu.obs import audit as _audit_mod
+                dg = _audit_mod.digest_epoch_window(
+                    closed, self.executor.epoch_window(closed))
+                self.auditor.seal(dg)
+                self.coordinator.record_ledger(dg.to_entry())
+                self.epoch_tracker.notify_epoch_sealed(closed, dg)
+                self._m_audit_sealed.inc()
             # Checkpoint at the fence: the lean fence snapshot (op state
             # + offsets; logs/rings are truncated on completion, not
             # persisted).
@@ -1704,6 +1772,26 @@ class ClusterRunner:
         self.failed.clear()
         if not drill:
             self.coordinator.reset_interval()
+        # Audit validation (obs/audit.py): recompute every replayed
+        # closed epoch's digest from the patched carry and compare
+        # against the sealed ledger — one match/divergence instant per
+        # epoch lands under this recovery's trace id (the closing
+        # "recovery" complete below comes after). Abort policy raises
+        # AuditDivergenceError here: fail loudly before the job resumes
+        # on state that did not reproduce the original execution.
+        if self.auditor.enabled:
+            validator = rec.AuditValidator(
+                self.executor, self.coordinator.read_ledger(),
+                on_divergence=self.auditor.on_divergence)
+            try:
+                validator.validate(
+                    range(from_epoch, self.executor.epoch_id))
+            finally:
+                # evidence reaches the metrics plane even when the
+                # abort policy throws mid-validation
+                self._m_audit_matches.inc(validator.stats["match"])
+                self._m_audit_div.inc(validator.stats["divergence"])
+            tp = _clock("audit", tp)
         report = RecoveryReport(
             failed_subtasks=failed, from_epoch=from_epoch,
             steps_replayed=n_steps, determinants_replayed=total_dets,

@@ -893,6 +893,56 @@ class LocalExecutor:
         self.async_counts = {k: v for k, v in self.async_counts.items()
                              if k[1] > epoch}
 
+    def epoch_window(self, epoch: int) -> Dict[str, Any]:
+        """Host snapshot of one CLOSED epoch's causal surface — the single
+        extraction path behind the audit digests (obs/audit.py): the live
+        seal at the epoch fence and the recovery-time recompute both read
+        through here, so their chain chunk boundaries always agree.
+
+        Returns ``{"logs": {flat: rows[n, NUM_LANES]},
+        "rings": {vid: [(keys, values, timestamps) per step]}}`` — the
+        determinant-row window of every subtask's causal log and, per
+        output ring, each step's valid records flattened in the
+        deterministic (lane, slot) order. Requires the epoch's rows to
+        still be retained (not truncated past) — true at the fence that
+        closes it and for every epoch at/after the latest completed
+        checkpoint during recovery."""
+        c = self.carry
+        rows = np.asarray(c.logs.rows)
+        heads = np.asarray(c.logs.head)
+        starts = np.asarray(c.logs.epoch_starts)
+        cap = rows.shape[1]
+        me = starts.shape[1]
+        logs: Dict[int, np.ndarray] = {}
+        for flat in range(rows.shape[0]):
+            s = int(starts[flat, epoch % me])
+            t = int(starts[flat, (epoch + 1) % me])
+            if t < s:               # next epoch's start not stamped yet
+                t = int(heads[flat])
+            pos = np.arange(s, t) & (cap - 1)
+            logs[flat] = np.ascontiguousarray(rows[flat][pos])
+        rings: Dict[int, list] = {}
+        for vid, ri in self.compiled.ring_index.items():
+            el = c.out_rings[ri]
+            keys = np.asarray(el.keys)
+            values = np.asarray(el.values)
+            stamps = np.asarray(el.timestamps)
+            valid = np.asarray(el.valid)
+            estarts = np.asarray(el.epoch_starts)
+            rme = estarts.shape[0]
+            s = int(estarts[epoch % rme])
+            t = int(estarts[(epoch + 1) % rme])
+            if t < s:
+                t = int(el.head)
+            rcap = keys.shape[0]
+            steps = []
+            for step in range(s, t):
+                p = step & (rcap - 1)
+                m = valid[p]
+                steps.append((keys[p][m], values[p][m], stamps[p][m]))
+            rings[vid] = steps
+        return {"logs": logs, "rings": rings}
+
     def _health_vector(self, carry: JobCarry) -> jnp.ndarray:
         """Pure: packed int32 [3 + num_rings + 1 + 1] health flags + total
         record count — ONE device value so the per-epoch control-plane

@@ -128,12 +128,30 @@ class JobMasterServer:
         """Cluster-wide metric view: every worker's last heartbeat
         snapshot, flattened under ``worker.<executor_id>.`` — the
         ``extra`` supplier for the JobMaster's MetricsEndpoint, so one
-        scrape covers the whole slot pool."""
+        scrape covers the whole slot pool. When any worker reports audit
+        gauges (obs/audit.py rides the same piggyback), a
+        ``cluster.audit.*`` rollup is appended — the live exactly-once
+        health line an operator alerts on; audit-off clusters get no
+        extra keys."""
         with self._lock:
             snaps = {eid: dict(m) for eid, m in self._hb_metrics.items()}
-        return {f"worker.{eid}.{name}": v
-                for eid, m in sorted(snaps.items())
-                for name, v in m.items()}
+        out = {f"worker.{eid}.{name}": v
+               for eid, m in sorted(snaps.items())
+               for name, v in m.items()}
+        audit = {k: v for k, v in out.items()
+                 if ".audit." in k and isinstance(v, (int, float))}
+        if audit:
+            sealed = sum(v for k, v in audit.items()
+                         if k.endswith("audit.epochs-sealed"))
+            validated = sum(v for k, v in audit.items()
+                            if k.endswith("audit.epochs-validated"))
+            div = sum(v for k, v in audit.items()
+                      if k.endswith("audit.divergences"))
+            out["cluster.audit.epochs-sealed"] = int(sealed)
+            out["cluster.audit.epochs-validated"] = int(validated)
+            out["cluster.audit.divergences"] = int(div)
+            out["cluster.audit.exactly-once-ok"] = int(div == 0)
+        return out
 
     def expired(self) -> List[str]:
         now = time.monotonic()

@@ -17,11 +17,29 @@ from __future__ import annotations
 
 import collections
 import json
+import re
 import threading
 import time
 from typing import Any, Callable, Deque, Dict, List, Optional
 
 import numpy as np
+
+# Prometheus exposition hygiene: metric names must match
+# [a-zA-Z_:][a-zA-Z0-9_:]* and label values escape backslash, quote and
+# newline (exposition format v0.0.4).
+_PROM_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _prom_name(name: str) -> str:
+    metric = _PROM_NAME_RE.sub("_", name)
+    if metric and metric[0].isdigit():
+        metric = "_" + metric
+    return metric or "_"
+
+
+def _prom_label_escape(v: Any) -> str:
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
 
 
 class Counter:
@@ -164,20 +182,56 @@ class MetricRegistry:
         for r in self._reporters:
             r.report(snap)
 
+    def _prometheus_type(self, name: str, v: Any) -> str:
+        """Exposition TYPE for one snapshot entry: registered metrics
+        map by class; merged extras (worker heartbeat snapshots) are
+        inferred from the value shape."""
+        with self._lock:
+            m = self._metrics.get(name)
+        if isinstance(m, Counter):
+            return "counter"
+        if isinstance(m, Histogram):
+            return "summary"
+        if isinstance(m, (Gauge, Meter)):
+            return "gauge"
+        if isinstance(v, dict):
+            return "summary" if {"count", "mean"} <= set(v) else "gauge"
+        return "gauge"
+
     def prometheus_text(self, snapshot: Optional[Dict[str, Any]] = None
                         ) -> str:
-        """Prometheus exposition-format dump of scalar metrics (pass a
-        pre-merged ``snapshot`` to include e.g. cluster-wide values)."""
+        """Prometheus exposition-format (v0.0.4) dump with ``# HELP`` /
+        ``# TYPE`` headers (pass a pre-merged ``snapshot`` to include
+        e.g. cluster-wide values). Names are sanitized to the exposition
+        charset; histogram snapshots flatten to ``<name>_{count,mean,
+        p50,p99}`` sample lines; string values (e.g. gauge-supplier
+        errors) render as info-style samples with the text in an escaped
+        ``value`` label rather than being dropped."""
         lines = []
         if snapshot is None:
             snapshot = self.snapshot()
         for name, v in sorted(snapshot.items()):
-            metric = name.replace(".", "_").replace("-", "_")
-            if isinstance(v, (int, float)):
+            metric = _prom_name(name)
+            lines.append(f"# HELP {metric} source metric {name}")
+            lines.append(
+                f"# TYPE {metric} {self._prometheus_type(name, v)}")
+            if isinstance(v, bool):
+                lines.append(f"{metric} {int(v)}")
+            elif isinstance(v, (int, float)):
                 lines.append(f"{metric} {v}")
             elif isinstance(v, dict):
                 for k2, v2 in v.items():
-                    lines.append(f"{metric}_{k2} {v2}")
+                    if isinstance(v2, bool):
+                        v2 = int(v2)
+                    if isinstance(v2, (int, float)):
+                        lines.append(f"{_prom_name(f'{metric}_{k2}')} {v2}")
+                    else:
+                        lines.append(
+                            f'{_prom_name(f"{metric}_{k2}")}'
+                            f'{{value="{_prom_label_escape(v2)}"}} 1')
+            else:
+                lines.append(
+                    f'{metric}{{value="{_prom_label_escape(v)}"}} 1')
         return "\n".join(lines) + "\n"
 
 

@@ -1,0 +1,53 @@
+"""Audit-bait job: spanning.py with an UNLOGGED nondeterministic map.
+
+``salt`` perturbs record VALUES with a module-level random constant
+drawn at import time — a stand-in for the classic exactly-once bug: an
+operator consulting state outside the causal log (an unlogged RNG draw,
+a wall clock, an env var). Replay after a process kill re-imports this
+module, draws a fresh SALT, and reproduces every key, count, window
+total and determinant row — only the record VALUES crossing the hash
+exchange differ. None of the framework's structural recovery checks can
+see that; the per-epoch audit digests (obs/audit.py fingerprint ring
+contents) are exactly what catches it, so the divergence test drives
+THIS job and asserts a ``recovery.audit.divergence`` on a ``ring/*``
+channel.
+
+Keys and counts stay deterministic on purpose: the job must pass every
+pre-audit recovery invariant (det-stream equality, output-cut counts,
+state digests) and fail ONLY the audit.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+from clonos_tpu.api.environment import StreamEnvironment
+
+VOCAB = 256
+WINDOW_MS = 500
+BATCH = 8
+
+# The nondeterminism: fresh per process, NOT recorded as a determinant.
+# 3 bytes keeps the salted arithmetic comfortably inside the int32
+# record lanes while a cross-process collision stays a 2^-24 fluke.
+SALT = 1 + int.from_bytes(os.urandom(3), "little")
+
+
+def build_job():
+    """lines -> tag -> (HASH) -> salt -> window -> sink.
+
+    The first HASH exchange is still the unique slice boundary, so a
+    two-worker slot-pool placement splits ``[lines, tag]`` from
+    ``[salt, window, sink]`` exactly like spanning.py — killing the
+    second worker replays ``salt`` under a different SALT."""
+    env = StreamEnvironment(name="audit-nondet", num_key_groups=64)
+    (env.host_source(batch_size=BATCH, parallelism=1, name="lines")
+        .map(lambda k, v, t: (k % VOCAB, v, t), name="tag")
+        .key_by()
+        .map(lambda k, v, t: (k, (v * 31 + SALT) % 9973, t), name="salt")
+        .key_by()
+        .window_count(num_keys=VOCAB, window_size=WINDOW_MS, name="window")
+        .sink(name="sink"))
+    return env.build()

@@ -12,6 +12,8 @@ the platform is forced through the config API after import.
 
 import os
 
+import pytest
+
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -27,3 +29,23 @@ from clonos_tpu.utils.compile_cache import enable_compile_cache  # noqa: E402
 
 enable_compile_cache(os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), ".jax_cache"))
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def pytest_configure(config):
+    """Register this repo's markers (tools/check_markers.py is the
+    single source of truth) and lint the suite for unregistered ones —
+    a typo'd marker is a silent no-op under ``-m 'not slow'``, so it
+    fails the session here instead."""
+    import sys
+    sys.path.insert(0, os.path.join(_REPO_ROOT, "tools"))
+    try:
+        import check_markers
+    finally:
+        sys.path.pop(0)
+    for name, help_text in check_markers.REGISTERED_MARKERS.items():
+        config.addinivalue_line("markers", f"{name}: {help_text}")
+    violations = check_markers.check(os.path.join(_REPO_ROOT, "tests"))
+    if violations:
+        raise pytest.UsageError("\n".join(violations))

@@ -1,0 +1,629 @@
+"""Epoch audit ledger: per-epoch digests, replay divergence detection,
+and live exactly-once health (clonos_tpu/obs/audit.py + digest.py).
+
+The framework's recovery tests prove replay lands bit-identically for
+DETERMINISTIC jobs; the audit plane is the runtime check that it
+actually did, every time. The headline test here is the converse of
+every other recovery test: a job with an *injected unlogged
+nondeterminism* (examples/audit_nondet.py — a value salt drawn outside
+the causal log) survives a SIGKILL recovery against every structural
+invariant and is caught ONLY by the audit validator, which names the
+first diverging epoch and channel in a ``recovery.audit.divergence``
+instant under the recovery's trace id.
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from clonos_tpu import obs
+from clonos_tpu.obs.digest import EpochDigest, diff, diff_ledgers
+from clonos_tpu.parallel import transport as tp
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _null_obs_after():
+    """Every test leaves the process-global tracer AND auditor off."""
+    yield
+    obs.reset()
+    obs.reset_audit()
+
+
+# --- digest unit tests -------------------------------------------------------
+
+
+def test_digest_fold_interleaving_and_merge_associativity():
+    """The epoch fingerprint is invariant to channel interleaving and to
+    how partial digests over disjoint channel sets are merged — but
+    sensitive to fold ORDER within one channel (the chain is ordered)."""
+    chunks = {"log/0": [b"d0", b"d1"], "ring/v2": [b"r0"],
+              "ring/v3": [b"r1", b"r2", b"r3"]}
+
+    def folded(order):
+        dg = EpochDigest(7)
+        for chan in order:
+            for c in chunks[chan]:
+                dg.fold(chan, c)
+        return dg
+
+    a = folded(["log/0", "ring/v2", "ring/v3"])
+    b = folded(["ring/v3", "log/0", "ring/v2"])
+    # Different channel interleavings: equal digests, equal fingerprints.
+    assert a == b and a.combined() == b.combined()
+    assert diff(a, b) is None
+
+    # Within-channel order matters: swapping two chunks diverges.
+    c = EpochDigest(7)
+    c.fold("log/0", b"d1")
+    c.fold("log/0", b"d0")
+    for ch in ("ring/v2", "ring/v3"):
+        for x in chunks[ch]:
+            c.fold(ch, x)
+    assert c.combined() != a.combined()
+    chan, reason = diff(a, c)
+    assert chan == "log/0" and "fingerprint" in reason
+
+    # Merge associativity over disjoint channel splits.
+    def part(*chans):
+        dg = EpochDigest(7)
+        for ch in chans:
+            for x in chunks[ch]:
+                dg.fold(ch, x)
+        return dg
+
+    p1, p2, p3 = part("log/0"), part("ring/v2"), part("ring/v3")
+    left = p1.merge(p2).merge(p3)
+    right = p1.merge(p2.merge(p3))
+    assert left == right == a
+    assert left.combined() == a.combined()
+    # Overlapping channels and mismatched epochs are caller bugs.
+    with pytest.raises(ValueError, match="sharing channels"):
+        p1.merge(part("log/0"))
+    with pytest.raises(ValueError, match="epochs"):
+        p1.merge(EpochDigest(8))
+
+    # det counts merge by summation and diff as the "det_counts" channel.
+    p1.count_det("rng", 3)
+    p2.count_det("rng", 1)
+    merged = p1.merge(p2)
+    assert merged.det_counts == {"rng": 4}
+    same_chans = part("log/0", "ring/v2", "ring/v3")
+    same_chans.count_det("rng", 5)
+    other = part("log/0", "ring/v2", "ring/v3")
+    other.count_det("rng", 4)
+    chan, reason = diff(same_chans, other)
+    assert chan == "det_counts"
+
+
+def test_digest_entry_roundtrip_and_ledger_diff():
+    dg = EpochDigest(3)
+    dg.fold("log/0", b"abc", 5)
+    dg.fold("ring/v1", b"xyz", 2)
+    dg.count_det("timestamp", 4)
+    entry = dg.to_entry()
+    # JSON-able and lossless.
+    back = EpochDigest.from_entry(json.loads(json.dumps(entry)))
+    assert back == dg and back.to_entry() == entry
+    assert entry["records"] == 7 and entry["epoch"] == 3
+    assert entry["channels"]["log/0"]["count"] == 5
+
+    # diff names the first diverging channel in sorted order.
+    short = EpochDigest(3)
+    short.fold("log/0", b"abc", 4)
+    short.fold("ring/v1", b"xyz", 2)
+    chan, reason = diff(dg, short)
+    assert chan == "log/0" and "count" in reason
+    missing = EpochDigest(3)
+    missing.fold("ring/v1", b"xyz", 2)
+    assert diff(dg, missing)[0] == "log/0"
+    assert diff(missing, dg)[1].startswith("unexpected")
+
+    # Ledger-level diff: per-epoch first divergences + missing epochs.
+    lines = diff_ledgers([entry, EpochDigest(4).to_entry()],
+                         [short.to_entry()])
+    assert any("epoch 3" in ln and "log/0" in ln for ln in lines)
+    assert any("epoch 4" in ln and "missing" in ln for ln in lines)
+    assert diff_ledgers([entry], [entry]) == []
+
+
+def test_null_auditor_default_no_wire_fields():
+    """Audit off (the default): NullAuditor, no wire fields, nothing
+    recorded — the exact NullTracer contract."""
+    a0 = obs.get_auditor()
+    assert isinstance(a0, obs.NullAuditor) and not a0.enabled
+    hdr = tp.attach_audit({"group": 1})
+    assert hdr == {"group": 1}, "disabled auditor must add no wire fields"
+    a0.seal(EpochDigest(0))
+    assert a0.ledger() == [] and a0.last_epoch == -1
+
+    # Opt-in: attach stamps the policy; a fresh process adopts it.
+    obs.configure_audit(on_divergence="abort")
+    hdr = tp.attach_audit({"group": 1})
+    assert hdr["audit"] == {"on_divergence": "abort"}
+    obs.reset_audit()
+    assert not obs.get_auditor().enabled
+    tp.adopt_audit(hdr)
+    assert obs.get_auditor().enabled
+    assert obs.get_auditor().on_divergence == "abort"
+    obs.reset_audit()
+    tp.adopt_audit({"group": 1})            # no audit field: stays off
+    assert not obs.get_auditor().enabled
+    with pytest.raises(ValueError, match="on_divergence"):
+        obs.configure_audit(on_divergence="explode")
+
+
+# --- in-process: seal at the fence, validate on recovery ---------------------
+
+
+def _small_job(name):
+    from clonos_tpu.api.environment import StreamEnvironment
+    env = StreamEnvironment(name=name, num_key_groups=8)
+    (env.synthetic_source(vocab=11, batch_size=4, parallelism=2)
+        .key_by()
+        .window_count(num_keys=11, window_size=1 << 30)
+        .sink())
+    return env.build()
+
+
+def test_recovery_validates_replayed_epochs_against_ledger(tmp_path):
+    """Acceptance (match path): every replayed epoch gets a
+    ``recovery.audit.match`` instant under the recovery's trace id, the
+    ledger persists next to the checkpoints, and the health gauges are
+    live in the registry."""
+    from clonos_tpu.runtime.cluster import ClusterRunner
+
+    tr = obs.configure("runner")
+    r = ClusterRunner(_small_job("aud"), steps_per_epoch=8,
+                      log_capacity=512, max_epochs=8,
+                      inflight_ring_steps=32, seed=3,
+                      checkpoint_dir=str(tmp_path / "ck"), audit=True)
+    assert r.auditor.enabled
+    r.run_epoch(complete_checkpoint=True)
+    r.run_epoch(complete_checkpoint=True)
+    r.run_epoch(complete_checkpoint=False)
+    r.run_epoch(complete_checkpoint=False)
+
+    # One durable ledger entry per sealed epoch, readable back.
+    lp = tmp_path / "ck" / "ledger.jsonl"
+    assert lp.exists()
+    entries = r.coordinator.read_ledger()
+    assert [e["epoch"] for e in entries] == [0, 1, 2, 3]
+    assert all(e["records"] > 0 and e["combined"] for e in entries)
+    assert r.auditor.epochs_sealed == 4 and r.auditor.last_epoch == 3
+
+    r.inject_failure([2 + 1])
+    report = r.recover()
+    assert report.from_epoch == 2
+    assert "audit" in report.phase_ms
+
+    recs = tr.records()
+    matches = [x for x in recs if x["name"] == "recovery.audit.match"]
+    assert [x["args"]["epoch"] for x in matches] == [2, 3], \
+        "one match instant per replayed epoch"
+    assert all(x["args"]["records"] > 0 for x in matches)
+    recovery = next(x for x in recs if x["name"] == "recovery")
+    assert {x["trace"] for x in matches} == {recovery["trace"]}, \
+        "audit instants join the recovery trace id"
+    assert not any(x["name"] == "recovery.audit.divergence" for x in recs)
+
+    snap = r.metrics.snapshot()
+    assert snap["job.aud.audit.enabled"] == 1
+    assert snap["job.aud.audit.epochs-sealed"] == 4
+    assert snap["job.aud.audit.epochs-validated"] == 2
+    assert snap["job.aud.audit.divergences"] == 0
+    assert snap["job.aud.audit.last-sealed-epoch"] == 3
+    assert 0.0 <= snap["job.aud.backpressure.inflight-occupancy"] <= 1.0
+    assert snap["job.aud.recovery.replay-lag-steps"] >= 0
+
+
+def test_audit_disabled_by_default_writes_no_ledger(tmp_path):
+    from clonos_tpu.runtime.cluster import ClusterRunner
+
+    r = ClusterRunner(_small_job("noaud"), steps_per_epoch=8,
+                      log_capacity=512, max_epochs=8,
+                      inflight_ring_steps=32, seed=3,
+                      checkpoint_dir=str(tmp_path / "ck"))
+    assert not r.auditor.enabled
+    r.run_epoch(complete_checkpoint=True)
+    r.run_epoch(complete_checkpoint=True)
+    assert not (tmp_path / "ck" / "ledger.jsonl").exists()
+    assert r.coordinator.read_ledger() == []
+    snap = r.metrics.snapshot()
+    assert snap["job.noaud.audit.enabled"] == 0
+    assert snap["job.noaud.audit.epochs-sealed"] == 0
+
+
+def test_tampered_ledger_divergence_warn_and_abort(tmp_path):
+    """A ledger that does not match the replay: warn counts and records
+    the instant; abort raises AuditDivergenceError naming epoch and
+    channel. Driven by tampering a sealed entry, the cheap determinated
+    stand-in for real nondeterminism (the SIGKILL test injects the real
+    thing)."""
+    from clonos_tpu.causal.recovery import (AuditDivergenceError,
+                                            AuditValidator)
+    from clonos_tpu.runtime.cluster import ClusterRunner
+
+    tr = obs.configure("runner")
+    r = ClusterRunner(_small_job("tamper"), steps_per_epoch=8,
+                      log_capacity=512, max_epochs=8,
+                      inflight_ring_steps=32, seed=3,
+                      checkpoint_dir=str(tmp_path / "ck"), audit=True)
+    r.run_epoch(complete_checkpoint=True)
+    r.run_epoch(complete_checkpoint=True)
+    r.run_epoch(complete_checkpoint=False)
+
+    entries = r.coordinator.read_ledger()
+    bad = json.loads(json.dumps(entries[-1]))          # epoch 2
+    first_chan = sorted(bad["channels"])[0]
+    bad["channels"][first_chan]["fp"] = "00" * 8
+
+    v = AuditValidator(r.executor, [bad], on_divergence="warn")
+    stats = v.validate([2])
+    assert stats == {"match": 0, "divergence": 1, "missing": 0}
+    ev = next(x for x in tr.records()
+              if x["name"] == "recovery.audit.divergence")
+    assert ev["args"]["epoch"] == 2
+    assert ev["args"]["channel"] == first_chan
+    assert "fingerprint" in ev["args"]["reason"]
+
+    va = AuditValidator(r.executor, [bad], on_divergence="abort")
+    with pytest.raises(AuditDivergenceError, match=first_chan.replace(
+            "/", "/")):
+        va.validate([2])
+    assert va.stats["divergence"] == 1
+
+    # Epochs absent from the ledger count as missing, not divergence.
+    vm = AuditValidator(r.executor, [], on_divergence="abort")
+    assert vm.validate([1]) == {"match": 0, "divergence": 0, "missing": 1}
+
+
+# --- torn-tail tolerance (SIGKILL artifacts) ---------------------------------
+
+
+def test_torn_final_lines_tolerated_everywhere(tmp_path):
+    """A SIGKILLed process tears its final JSONL line; both the trace
+    loader and the ledger reader drop the tail and keep everything
+    before it. Corruption ANYWHERE ELSE still raises."""
+    from clonos_tpu.runtime.checkpoint import read_ledger_file
+
+    torn = tmp_path / "trace-x.jsonl"
+    torn.write_text('{"name": "a", "ts": 1.0}\n'
+                    '{"name": "b", "ts": 2.0}\n'
+                    '{"name": "c", "ts": 3.')
+    recs = obs.load_jsonl(str(torn))
+    assert [r["name"] for r in recs] == ["a", "b"]
+
+    led = tmp_path / "ledger.jsonl"
+    led.write_text('{"epoch": 0, "combined": "aa"}\n'
+                   '{"epoch": 1, "com')
+    assert [e["epoch"] for e in read_ledger_file(str(led))] == [0]
+    assert read_ledger_file(str(tmp_path / "absent.jsonl")) == []
+
+    broken = tmp_path / "trace-y.jsonl"
+    broken.write_text('{"name": "a", "ts": 1.0}\n'
+                      'NOT JSON\n'
+                      '{"name": "c", "ts": 3.0}\n')
+    with pytest.raises(ValueError, match="trace-y.jsonl:2"):
+        obs.load_jsonl(str(broken))
+    bled = tmp_path / "ledger2.jsonl"
+    bled.write_text('NOT JSON\n{"epoch": 1}\n')
+    with pytest.raises(json.JSONDecodeError):
+        read_ledger_file(str(bled))
+
+
+# --- prometheus exposition hygiene -------------------------------------------
+
+
+def test_prometheus_exposition_hygiene():
+    from clonos_tpu.utils import metrics as met
+
+    reg = met.MetricRegistry()
+    g = reg.group("job.x")
+    g.counter("audit.epochs-sealed").inc(4)
+    g.gauge("audit.enabled", lambda: True)
+    g.histogram("epoch.steps-ms").update(2.0)
+    snap = reg.snapshot()
+    snap["worker.a.status"] = 'up "and\\running"\nok'
+    snap["9lives"] = 1
+    txt = reg.prometheus_text(snap)
+    lines = txt.splitlines()
+
+    # Flattened sample lines keep the historical shape...
+    assert "job_x_audit_epochs_sealed 4" in lines
+    assert "job_x_audit_enabled 1" in lines, "bools render as 0/1"
+    assert any(ln.startswith("job_x_epoch_steps_ms_p99 ") for ln in lines)
+    # ...now under HELP/TYPE headers with registry-derived types.
+    assert "# TYPE job_x_audit_epochs_sealed counter" in lines
+    assert "# TYPE job_x_audit_enabled gauge" in lines
+    assert "# TYPE job_x_epoch_steps_ms summary" in lines
+    assert "# HELP job_x_audit_epochs_sealed source metric " \
+           "job.x.audit.epochs-sealed" in lines
+    # Leading digits are guarded; every sample name is exposition-legal.
+    assert "_9lives 1" in lines
+    import re
+    name_re = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*")
+    for ln in lines:
+        if ln and not ln.startswith("#"):
+            assert name_re.match(ln), ln
+    # String values render as labeled info samples, fully escaped,
+    # instead of being dropped.
+    esc = next(ln for ln in lines if ln.startswith("worker_a_status"))
+    assert esc == ('worker_a_status{value="up \\"and\\\\running\\"\\nok"} 1')
+
+
+def test_cluster_metrics_rolls_up_audit_health():
+    """The JobMaster's cluster view appends a ``cluster.audit.*`` rollup
+    (the exactly-once health line) iff any worker reports audit gauges."""
+    from clonos_tpu.runtime.remote import JobMasterServer, TaskExecutorClient
+
+    jm = JobMasterServer(heartbeat_timeout_s=30.0)
+    c = None
+    try:
+        assert "cluster.audit.exactly-once-ok" not in jm.cluster_metrics()
+        c = TaskExecutorClient(
+            "a", jm.address, interval_s=0.05,
+            payload_fn=lambda: {"metrics": {
+                "group.1.audit.epochs-sealed": 6,
+                "group.1.audit.epochs-validated": 2,
+                "group.1.audit.divergences": 1,
+                "group.1.supersteps": 12}})
+        deadline = time.monotonic() + 20
+        while "cluster.audit.exactly-once-ok" not in jm.cluster_metrics():
+            assert time.monotonic() < deadline, "rollup never appeared"
+            time.sleep(0.02)
+        cm = jm.cluster_metrics()
+        assert cm["cluster.audit.epochs-sealed"] == 6
+        assert cm["cluster.audit.epochs-validated"] == 2
+        assert cm["cluster.audit.divergences"] == 1
+        assert cm["cluster.audit.exactly-once-ok"] == 0
+    finally:
+        if c is not None:
+            c.close()
+        jm.close()
+
+
+# --- the audit CLI -----------------------------------------------------------
+
+
+def test_audit_cli_prints_and_diffs_ledgers(tmp_path, capsys):
+    from clonos_tpu.cli import main
+
+    def write_ledger(dirpath, entries):
+        os.makedirs(dirpath, exist_ok=True)
+        with open(os.path.join(dirpath, "ledger.jsonl"), "w") as f:
+            for e in entries:
+                f.write(json.dumps(e) + "\n")
+
+    def entry(epoch, payload):
+        d = EpochDigest(epoch)
+        d.fold("ring/v2", payload, 4)
+        d.count_det("rng", 2)
+        return d.to_entry()
+
+    run1 = tmp_path / "run1"
+    run2 = tmp_path / "run2"
+    write_ledger(str(run1 / "g0"), [entry(0, b"aa"), entry(1, b"bb")])
+    write_ledger(str(run1 / "g1"), [entry(0, b"cc")])
+    write_ledger(str(run2 / "g0"), [entry(0, b"aa"), entry(1, b"XX")])
+    write_ledger(str(run2 / "g1"), [entry(0, b"cc")])
+
+    assert main(["audit", str(run1)]) == 0
+    out = capsys.readouterr().out
+    assert "g0/ledger.jsonl" in out and "g1/ledger.jsonl" in out
+    assert "epoch    0" in out and "rng=2" in out
+
+    # Identical ledgers: exit 0; diverging: exit 1 naming epoch+channel.
+    assert main(["audit", str(run1), "--diff", str(run1)]) == 0
+    assert "ledgers match" in capsys.readouterr().out
+    assert main(["audit", str(run1), "--diff", str(run2)]) == 1
+    out = capsys.readouterr().out
+    assert "epoch 1" in out and "ring/v2" in out and "g0" in out
+    assert "epoch 0" not in out
+
+    assert main(["audit", str(run1), "--json"]) == 0
+    js = json.loads(capsys.readouterr().out)
+    assert js["g0/ledger.jsonl"][0]["epoch"] == 0
+
+    assert main(["audit", str(tmp_path / "empty")]) == 1
+
+
+def test_marker_lint_passes_and_flags_unregistered(tmp_path):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import check_markers
+    finally:
+        sys.path.pop(0)
+    assert check_markers.check(os.path.join(REPO, "tests")) == []
+    bad = tmp_path / "test_bad.py"
+    # the typo'd marker is assembled at runtime so THIS file (which the
+    # lint also scans) doesn't trip it
+    bad.write_text("import pytest\n"
+                   "@pytest.mark.%s\ndef test_x():\n    pass\n" % "sloow")
+    violations = check_markers.check(str(tmp_path))
+    assert len(violations) == 1 and "sloow" in violations[0]
+
+
+# --- THE acceptance run: injected nondeterminism caught over SIGKILL ---------
+
+
+def _line_server(lines):
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(2)
+
+    def serve():
+        try:
+            while True:
+                conn, _ = srv.accept()
+                conn.sendall("".join(f"{k}:{v}\n"
+                                     for k, v in lines).encode())
+        except OSError:
+            return
+
+    threading.Thread(target=serve, daemon=True).start()
+    return srv, srv.getsockname()[1]
+
+
+def _read_status(proc, want, deadline_s=300.0):
+    deadline = time.monotonic() + deadline_s
+    for line in iter(proc.stdout.readline, ""):
+        assert time.monotonic() < deadline, "worker status timeout"
+        st = json.loads(line)
+        if want(st):
+            return st
+    raise AssertionError("worker stdout closed before expected status")
+
+
+def test_sigkill_replay_divergence_detected_across_processes(tmp_path):
+    """Acceptance: the slot-pool SIGKILL run over
+    examples/audit_nondet.py — a job whose ``salt`` map perturbs record
+    values with an unlogged per-process random constant. The kill lands
+    on the worker running ``[salt, window, sink]``; the rebuild on the
+    surviving worker replays under a DIFFERENT salt, reproducing every
+    key, count, determinant row and window total — so recovery's
+    structural checks all pass and the run completes. Only the audit
+    validator can see it: the replayed ring contents differ, and every
+    replayed epoch must produce a ``recovery.audit.divergence`` naming
+    the epoch and a ``ring/*`` channel, under the recovery's trace id,
+    with the divergence count surfacing in the JobMaster's cluster
+    health rollup."""
+    from clonos_tpu.runtime import scheduler as sch
+    from clonos_tpu.runtime.leader import FileLeaderElection
+    from clonos_tpu.runtime.remote import JobMasterServer
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    trace_dir = tmp_path / "traces"
+    trace_dir.mkdir()
+    lease = str(tmp_path / "jm.lease")
+    lines = [((i * 37) % 997, 1 + i % 5) for i in range(600)]
+    srv, lport = _line_server(lines)
+
+    jm_tracer = obs.configure("jm", path=str(trace_dir / "trace-jm.jsonl"))
+    obs.configure_audit(on_divergence="warn")
+    jm = JobMasterServer(heartbeat_timeout_s=2.0)
+    election = FileLeaderElection(lease, "jm-0", lease_ttl_s=30.0)
+    assert election.try_acquire()
+    runner_kw = dict(steps_per_epoch=4, log_capacity=512, max_epochs=64,
+                     inflight_ring_steps=64, seed=7, logical_time=True,
+                     audit=True)
+    scheduler = sch.SlotPoolScheduler(
+        jm, election, "examples.audit_nondet:build_job",
+        runner_kw=runner_kw, feed_batch=4, target_epochs=8,
+        complete_every=4, checkpoint_root=str(tmp_path / "ck"),
+        deploy_timeout_s=300.0)
+
+    def spawn(eid):
+        return subprocess.Popen(
+            [sys.executable, "-m", "clonos_tpu", "slotworker",
+             "--jm", f"127.0.0.1:{jm.address[1]}",
+             "--executor-id", eid, "--slots", "2", "--lease", lease,
+             "--heartbeat-interval", "0.3", "--max-seconds", "600",
+             "--epoch-sleep", "0.25", "--trace-dir", str(trace_dir)],
+            cwd=REPO, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL, text=True)
+
+    pa, pb = spawn("a"), spawn("b")
+    try:
+        assert json.loads(pa.stdout.readline())["registered"] == "a"
+        assert json.loads(pb.stdout.readline())["registered"] == "b"
+        deadline = time.monotonic() + 30
+        while {"a", "b"} - set(jm.registered()):
+            assert time.monotonic() < deadline
+            time.sleep(0.05)
+
+        placements = scheduler.deploy(external_feeds={
+            0: {"kind": "socket", "host": "127.0.0.1", "port": lport,
+                "num_subtasks": 1}})
+        # The nondeterministic slice [salt, window, sink] is group 1.
+        assert placements == {0: "a", 1: "b"}
+        _read_status(pa, lambda st: st.get("deployed") == 0)
+        _read_status(pb, lambda st: st.get("deployed") == 1)
+        _read_status(pa, lambda st: st.get("finished") == 0)
+
+        # Kill timing is what makes the replay window NON-EMPTY: with
+        # completions only at epochs 0 and 4 (complete_every=4), any
+        # kill after epoch 5 closes (epoch_id >= 6, mirror fence >= 6,
+        # restore point chk_4) replays at least epoch 5 — killing right
+        # after a completed checkpoint would replay nothing and give the
+        # validator an empty range.
+        def at_fence(st):
+            if "group" in st and "digest" in st:
+                scheduler.sync()
+            return st.get("epoch", -1) >= 6 or "finished" in st
+
+        _read_status(pb, at_fence)
+        pb.send_signal(signal.SIGKILL)
+        pb.wait(timeout=15)
+
+        deadline = time.monotonic() + 20
+        while "b" not in scheduler.failed_workers():
+            assert time.monotonic() < deadline, "heartbeat expiry not seen"
+            time.sleep(0.1)
+
+        # Recovery SUCCEEDS under warn: the job is structurally sound.
+        assert scheduler.recover_worker("b") == {1: "a"}
+        dep = _read_status(pa, lambda st: st.get("deployed") == 1)
+        assert dep["recovered"] and dep["vertices"] == [2, 3, 4]
+
+        # The divergence count reaches the JobMaster's cluster rollup
+        # over HEARTBEAT: the live exactly-once health line trips.
+        deadline = time.monotonic() + 60
+        while jm.cluster_metrics().get("cluster.audit.divergences", 0) < 1:
+            assert time.monotonic() < deadline, \
+                f"no divergence in rollup: {sorted(jm.cluster_metrics())}"
+            time.sleep(0.2)
+        cm = jm.cluster_metrics()
+        assert cm["cluster.audit.exactly-once-ok"] == 0
+        assert cm["cluster.audit.epochs-sealed"] >= 1
+
+        # ...and the job still runs to its target (warn, not abort).
+        fin = _read_status(pa, lambda st: st.get("finished") == 1)
+        assert fin["global_step"] == 8 * runner_kw["steps_per_epoch"]
+    finally:
+        for p in (pa, pb):
+            if p.poll() is None:
+                p.kill()
+        scheduler.close()
+        jm.close()
+        srv.close()
+        obs.reset()
+
+    # --- the audit evidence, reconstructed from the trace files --------------
+    T = jm_tracer.trace_id
+    paths = [str(trace_dir / f"trace-{s}.jsonl") for s in ("jm", "a", "b")]
+    records = obs.load_jsonl([p for p in paths if os.path.exists(p)])
+    ours = [r for r in records if r["trace"] == T]
+
+    divs = [r for r in ours if r["name"] == "recovery.audit.divergence"]
+    assert divs, ("no recovery.audit.divergence in trace: "
+                  f"{sorted({r['name'] for r in ours})}")
+    # Emitted by the surviving worker's rebuild, under the SAME trace id
+    # as the recovery spans.
+    assert {r["service"] for r in divs} == {"a"}
+    recovery = next(r for r in ours
+                    if r["name"] == "recovery" and r["service"] == "a")
+    assert {r["trace"] for r in divs} == {recovery["trace"]}
+    # The first divergence names the first replayed epoch and a ring
+    # channel (the salted VALUES): determinant logs reproduced fine.
+    first = min(divs, key=lambda r: r["args"]["epoch"])
+    assert first["args"]["channel"].startswith("ring/")
+    assert "content divergence" in first["args"]["reason"]
+    replayed = sorted(r["args"]["epoch"] for r in divs)
+    assert replayed[0] == min(replayed)
+    # Every pre-kill epoch was sealed by the dead worker: entries exist
+    # in the group's durable ledger for everything the validator saw.
+    from clonos_tpu.runtime.checkpoint import read_ledger_file
+    entries = read_ledger_file(str(tmp_path / "ck" / "g1" /
+                                   "ledger.jsonl"))
+    sealed = {e["epoch"] for e in entries}
+    assert set(replayed) <= sealed

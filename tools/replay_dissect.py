@@ -1,120 +1,15 @@
 #!/usr/bin/env python
-"""Dissect the warm replay at full bench shapes: what the min-of-5
-``replayer.replay(plan)`` wall actually spends — dispatch-chain compute
-(amortized over a chained loop, tunnel RTT excluded) vs the single d2h
-sync. Optimization must target whichever dominates."""
+"""Shim: the dissection moved into the package CLI as ``clonos_tpu
+dissect`` (clonos_tpu/cli.py:cmd_dissect) so it shares the subcommand
+plumbing instead of carrying its own bootstrap. This wrapper keeps the
+old ``python tools/replay_dissect.py`` invocation working."""
 
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import numpy as np
-
-
-def main():
-    import jax
-    import jax.numpy as jnp
-    import bench
-    from clonos_tpu.runtime.cluster import ClusterRunner
-    from clonos_tpu.runtime.executor import DETS_PER_STEP
-    from clonos_tpu.utils.devsync import device_sync
-
-    SPE = bench.STEPS_PER_EPOCH
-    job = bench.build_job()
-    need = bench.FILL_EPOCHS * SPE * DETS_PER_STEP
-    cap = 1 << need.bit_length()
-    runner = ClusterRunner(job, steps_per_epoch=SPE, log_capacity=cap,
-                           max_epochs=16,
-                           inflight_ring_steps=1 << max(
-                               bench.FILL_EPOCHS * SPE, 2).bit_length(),
-                           recovery_block_steps=8192, block_steps=1024,
-                           seed=7)
-    t0 = time.monotonic()
-    runner.run_epoch(complete_checkpoint=True)
-    device_sync(runner.executor.carry)
-    print("epoch0:", round(time.monotonic() - t0, 1), "s", flush=True)
-    t0 = time.monotonic()
-    for _ in range(bench.FILL_EPOCHS):
-        runner.run_epoch(complete_checkpoint=False)
-    device_sync(runner.executor.carry)
-    print("fill:", round(time.monotonic() - t0, 1), "s", flush=True)
-
-    failed = bench.PAR + 1
-    runner.inject_failure([failed])
-    t0 = time.monotonic()
-    report = runner.recover()
-    device_sync(runner.executor.carry)
-    print("cold recover:", round(time.monotonic() - t0, 1), "s",
-          {k: round(v, 1) for k, v in report.phase_ms.items()}, flush=True)
-
-    mgr = report.managers[0]
-    replayer = mgr.replayer
-    plan = mgr.plan
-
-    # (a) bench's exact warm-replay measurement
-    for trial in range(5):
-        t1 = time.monotonic()
-        result = replayer.replay(plan)
-        device_sync(result.emit_counts)
-        print(f"warm replay #{trial}: "
-              f"{(time.monotonic() - t1) * 1e3:.1f}ms  phases:",
-              {k: round(v, 1) for k, v in result.phase_ms.items()},
-              flush=True)
-
-    # (b) amortized compute of the core block program alone (tunnel RTT
-    # excluded): chain N iterations inside one jit, one sync at the end.
-    dev = plan.det_device is not None
-    print("clean device path:", dev, "n_steps:", plan.n_steps, flush=True)
-    if dev:
-        t_dev, r_dev, _exp = plan.det_device
-        chunk = plan.input_steps[0] if isinstance(plan.input_steps, list) \
-            else plan.input_steps
-        state0 = jax.tree_util.tree_map(
-            lambda x: x[plan.subtask][None], plan.checkpoint_op_state)
-        sub = jnp.asarray(plan.subtask, jnp.int32)
-        N = 10
-        jb = replayer._jit_block
-
-        def chained():
-            acc = jnp.zeros((), jnp.int32)
-            for _ in range(N):
-                st, out, counts, acc = jb(
-                    state0, chunk, t_dev[:replayer.block_steps],
-                    r_dev[:replayer.block_steps], sub, acc)
-            return counts
-        r = chained()
-        np.asarray(r.ravel()[0])
-        ts = []
-        for _ in range(3):
-            t1 = time.monotonic()
-            r = chained()
-            np.asarray(r.ravel()[0])
-            ts.append((time.monotonic() - t1) * 1e3)
-        print(f"block program amortized: {min(ts) / N:.2f}ms per call "
-              f"(chain of {N}: {min(ts):.1f}ms)", flush=True)
-
-        # (c) tail ops: tslice + concat cost
-        def tail():
-            acc = jnp.zeros((), jnp.int32)
-            st, out, counts, acc = jb(state0, chunk,
-                                      t_dev[:replayer.block_steps],
-                                      r_dev[:replayer.block_steps], sub, acc)
-            packed = jnp.concatenate(
-                [counts, acc.reshape(1), _exp[:plan.n_steps]], axis=0)
-            return packed
-        p = tail()
-        np.asarray(p.ravel()[0])
-        ts = []
-        for _ in range(5):
-            t1 = time.monotonic()
-            p = tail()
-            np.asarray(p.ravel()[0])
-            ts.append((time.monotonic() - t1) * 1e3)
-        print(f"block+concat+sync single: min={min(ts):.1f}ms "
-              f"p50={sorted(ts)[2]:.1f}ms", flush=True)
-
+from clonos_tpu.cli import main  # noqa: E402
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main(["dissect"] + sys.argv[1:]))
