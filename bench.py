@@ -276,6 +276,51 @@ def sharing_depth_sweep():
     return out
 
 
+def overhead_probe():
+    """FT-overhead attribution at bench shapes (obs/profile.py): a
+    short PROFILED run. The profiler fences device dispatch to make
+    section walls meaningful, which serializes the pipeline — so this
+    runs separately, after the headline measurement, and never touches
+    the pipelined throughput numbers. Reports the per-epoch
+    ``overhead.ft-fraction`` (last closed window, warm) plus the
+    lifetime per-section breakdown."""
+    import gc
+    from clonos_tpu.obs import profile as prof_mod
+    from clonos_tpu.runtime.cluster import ClusterRunner
+    from clonos_tpu.runtime.executor import DETS_PER_STEP
+
+    SPE = int(os.environ.get("BENCH_PROFILE_SPE", 1024))
+    prof_mod.configure_profile()
+    try:
+        job = build_job()
+        need = 2 * SPE * DETS_PER_STEP
+        runner = ClusterRunner(
+            job, steps_per_epoch=SPE,
+            log_capacity=1 << need.bit_length(), max_epochs=16,
+            inflight_ring_steps=1 << (SPE - 1).bit_length(), seed=7)
+        runner.run_epoch(complete_checkpoint=True)   # compile warmup
+        for _ in range(2):
+            runner.run_epoch(complete_checkpoint=True)
+        device_sync(runner.executor.carry)
+        prof = prof_mod.get_profiler()
+        sections = {k: round(v * 1e3, 2)
+                    for k, v in sorted(prof.lifetime().items())}
+        out = {
+            # Last closed epoch window — warm, the gauge /metrics serves.
+            "overhead_ft_fraction": prof.ft_fraction(),
+            # Whole probe incl. the compile-warmup epoch (upper bound).
+            "overhead_ft_fraction_lifetime": round(
+                prof.lifetime_ft_fraction(), 6),
+            "sections_ms_lifetime": sections,
+            "steps_per_epoch": SPE,
+        }
+        del runner
+        gc.collect()
+        return out
+    finally:
+        prof_mod.reset_profile()
+
+
 def main():
     import jax
     from clonos_tpu.runtime.cluster import ClusterRunner
@@ -406,6 +451,13 @@ def main():
             / JVM_BASELINE_RECORDS_PER_SEC, 3),
         "recovery_phase_ms": {k: round(v, 1)
                               for k, v in report.phase_ms.items()},
+        # The finalize mystery, attributable: named sub-spans of the
+        # finalize phase (barrier read, state verify, and — on standby
+        # bootstraps — rehydrate/reattach/reregister/recompile).
+        "finalize_phase_ms": {k: round(v, 1)
+                              for k, v in report.phase_ms.items()
+                              if k == "finalize"
+                              or k.startswith("finalize.")},
         "steps_replayed": report.steps_replayed,
         "records_replayed": report.records_replayed,
         "buffered_determinants_cluster": buffered,
@@ -446,6 +498,21 @@ def main():
         out["sharing_depth_sweep"] = sharing_depth_sweep()
     except Exception as e:                            # pragma: no cover
         out["sharing_depth_sweep"] = {"error": str(e)}
+    # FT-overhead attribution probe (profiled, serialized dispatch —
+    # never shares the pipelined headline run). Hoists the headline
+    # fraction to the top level for dashboards.
+    if time.monotonic() - T_START > budget_s:
+        out["overhead_probe"] = {"skipped": "bench wall-clock budget "
+                                            "exhausted"}
+        out["overhead_ft_fraction"] = None
+    else:
+        try:
+            out["overhead_probe"] = overhead_probe()
+            out["overhead_ft_fraction"] = \
+                out["overhead_probe"]["overhead_ft_fraction"]
+        except Exception as e:                        # pragma: no cover
+            out["overhead_probe"] = {"error": str(e)}
+            out["overhead_ft_fraction"] = None
     print(json.dumps(out))
 
 

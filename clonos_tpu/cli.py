@@ -49,10 +49,47 @@ def _setup_tracer(args, service: str):
         args.trace_dir, f"trace-{service}.jsonl"))
 
 
+def _setup_profile(args) -> None:
+    """Opt-in overhead attribution: ``--profile`` installs the process
+    profiler BEFORE any runner is built (runners bind the process
+    profiler at construction — a slotworker's deployed slices inherit
+    it the same way)."""
+    if getattr(args, "profile", False):
+        from clonos_tpu.obs import configure_profile
+        configure_profile()
+
+
+def _make_history(args):
+    """A MetricsHistory per the ``--history-*`` flags (sampled by the
+    endpoint it is handed to)."""
+    from clonos_tpu.obs import MetricsHistory
+    return MetricsHistory(path=getattr(args, "history_file", None),
+                          interval_s=args.history_interval,
+                          window=args.history_window)
+
+
+def _add_profile_args(sp) -> None:
+    """Shared observability flags for the serving entrypoints."""
+    sp.add_argument("--profile", action="store_true",
+                    help="attribute fault-tolerance overhead per section "
+                         "(overhead.* metrics + overhead.ft-fraction; "
+                         "off by default: zero overhead, async dispatch "
+                         "preserved)")
+    sp.add_argument("--history-interval", type=float, default=2.0,
+                    help="metrics-history sampling period for "
+                         "/metrics/history.json (seconds)")
+    sp.add_argument("--history-window", type=int, default=512,
+                    help="samples kept in the metrics-history ring")
+    sp.add_argument("--history-file", default=None,
+                    help="also persist history samples to this JSONL "
+                         "file (ring resumes from its tail on restart)")
+
+
 def cmd_run(args) -> int:
     from clonos_tpu.runtime.cluster import ClusterRunner
 
     tracer = _setup_tracer(args, "run")
+    _setup_profile(args)
     job = _load_job(args.job)
     runner = ClusterRunner(job, steps_per_epoch=args.steps_per_epoch,
                            checkpoint_dir=args.checkpoint_dir)
@@ -60,7 +97,8 @@ def cmd_run(args) -> int:
     if args.metrics_port is not None:
         from clonos_tpu.utils.metrics import MetricsEndpoint
         endpoint = MetricsEndpoint(runner.metrics, port=args.metrics_port,
-                                   tracer=tracer)
+                                   tracer=tracer,
+                                   history=_make_history(args))
         print(f"# metrics: http://{endpoint.address[0]}:"
               f"{endpoint.address[1]}/metrics", file=sys.stderr)
     t0 = time.monotonic()
@@ -125,6 +163,7 @@ def cmd_worker(args) -> int:
                                            TaskExecutorClient)
 
     _setup_tracer(args, args.executor_id)
+    _setup_profile(args)
     ctx = distributed.initialize(args.coordinator, args.num_processes,
                                  args.process_id)
     job = _load_job(args.job)
@@ -176,6 +215,7 @@ def cmd_slotworker(args) -> int:
     from clonos_tpu.runtime.scheduler import SliceWorker
 
     tracer = _setup_tracer(args, args.executor_id)
+    _setup_profile(args)
     host, _, port = args.jm.partition(":")
     worker = SliceWorker(
         args.executor_id, (host, int(port)), lease_path=args.lease,
@@ -189,7 +229,8 @@ def cmd_slotworker(args) -> int:
         # same dict its heartbeats piggyback to the JobMaster).
         endpoint = MetricsEndpoint(
             MetricRegistry(), port=args.metrics_port,
-            extra=lambda: dict(worker._metrics_cache), tracer=tracer)
+            extra=lambda: dict(worker._metrics_cache), tracer=tracer,
+            history=_make_history(args))
         print(f"# metrics: http://{endpoint.address[0]}:"
               f"{endpoint.address[1]}/metrics", file=sys.stderr)
     print(json.dumps({"registered": args.executor_id,
@@ -234,21 +275,43 @@ def cmd_audit(args) -> int:
 
     ledgers = _find_ledgers(args.dir)
     if not ledgers:
-        print(f"no ledger.jsonl under {args.dir}", file=sys.stderr)
+        if args.report == "json":
+            print(json.dumps({"match": False, "groups": {},
+                              "problems": [f"no ledger.jsonl under "
+                                           f"{args.dir}"]}))
+        else:
+            print(f"no ledger.jsonl under {args.dir}", file=sys.stderr)
         return 1
     if args.diff:
         other = dict(_find_ledgers(args.diff))
         problems = []
+        groups = {}
         for label, entries in ledgers:
-            problems += [f"{label}: {line}" for line in
-                         _digest.diff_ledgers(entries,
-                                              other.get(label, []))]
+            lines = _digest.diff_ledgers(entries, other.get(label, []))
+            groups[label] = {"entries": len(entries),
+                             "epochs": len({e.get("epoch")
+                                            for e in entries}),
+                             "problems": lines}
+            problems += [f"{label}: {line}" for line in lines]
+        if args.report == "json":
+            # CI convention: one machine-readable line, exit 0/1.
+            print(json.dumps({"match": not problems, "groups": groups,
+                              "problems": problems}))
+            return 1 if problems else 0
         for line in problems:
             print(line)
         if not problems:
             print(f"ledgers match ({sum(len(e) for _, e in ledgers)} "
                   f"entries)")
         return 1 if problems else 0
+    if args.report == "json":
+        groups = {label: {"entries": len(entries),
+                          "epochs": len({e.get("epoch")
+                                         for e in entries})}
+                  for label, entries in ledgers}
+        print(json.dumps({"match": True, "groups": groups,
+                          "problems": []}))
+        return 0
     if args.json:
         print(json.dumps({label: entries for label, entries in ledgers},
                          indent=2))
@@ -266,6 +329,115 @@ def cmd_audit(args) -> int:
                   f"channels {len(e.get('channels') or {}):>3}  "
                   f"combined {e.get('combined', '?')}  {dets}")
     return 0
+
+
+def _top_rows(snap):
+    """Fold a JobMaster ``/metrics.json`` snapshot into per-worker rows.
+
+    Keys arrive flattened as ``worker.<eid>.<metric>`` where ``<metric>``
+    is the worker's own snapshot name (e.g.
+    ``group.g0.job.bench.audit.epochs-sealed``); suffix-match so the row
+    survives arbitrary group/job nesting. Histogram values are the
+    flattened ``{count, mean, p50, p99}`` dicts snapshot() emits."""
+    workers = {}
+
+    def row(eid):
+        return workers.setdefault(eid, {
+            "slots": None, "groups": set(), "sealed": 0, "validated": 0,
+            "ring": None, "lag": None, "ft": None, "phases": {}})
+
+    for key, v in snap.items():
+        if not key.startswith("worker."):
+            continue
+        eid, _, rest = key[len("worker."):].partition(".")
+        if not eid or not rest:
+            continue
+        r = row(eid)
+        if rest == "slots" and isinstance(v, (int, float)):
+            r["slots"] = int(v)
+            continue
+        if rest.startswith("group."):
+            r["groups"].add(rest.split(".", 2)[1])
+        num = isinstance(v, (int, float)) and not isinstance(v, bool)
+        if num and rest.endswith(".audit.epochs-sealed"):
+            r["sealed"] += int(v)
+        elif num and rest.endswith(".audit.epochs-validated"):
+            r["validated"] += int(v)
+        elif num and (rest.endswith(".backpressure.inflight-occupancy")
+                      or rest.endswith(".causal-log.max-occupancy")):
+            r["ring"] = max(r["ring"] or 0.0, float(v))
+        elif num and rest.endswith(".recovery.replay-lag-steps"):
+            r["lag"] = max(r["lag"] or 0, int(v))
+        elif num and rest.endswith(".overhead.ft-fraction"):
+            r["ft"] = max(r["ft"] or 0.0, float(v))
+        elif (isinstance(v, dict) and ".recovery." in rest
+              and rest.endswith("-ms") and v.get("count")):
+            phase = rest.rsplit(".recovery.", 1)[1][:-len("-ms")]
+            r["phases"][phase] = float(v.get("p50") or v.get("mean") or 0)
+    return workers
+
+
+def _top_table(snap) -> str:
+    """Render one ``clonos_tpu top`` frame from a /metrics.json dict."""
+    rows = _top_rows(snap)
+    lines = [f"{'WORKER':<18} {'SLOTS':>5} {'GROUPS':>6} {'SEALED':>6} "
+             f"{'VALID':>5} {'RING':>6} {'LAG':>5} {'FT%':>7}  "
+             f"RECOVERY p50 ms"]
+    for eid in sorted(rows):
+        r = rows[eid]
+        slots = "-" if r["slots"] is None else str(r["slots"])
+        ring = "-" if r["ring"] is None else f"{r['ring']:.2f}"
+        lag = "-" if r["lag"] is None else str(r["lag"])
+        ft = "-" if r["ft"] is None else f"{r['ft'] * 100:.2f}"
+        phases = " ".join(f"{k}={v:.0f}"
+                          for k, v in sorted(r["phases"].items()))
+        lines.append(f"{eid:<18} {slots:>5} {len(r['groups']):>6} "
+                     f"{r['sealed']:>6} {r['validated']:>5} {ring:>6} "
+                     f"{lag:>5} {ft:>7}  {phases}")
+    if not rows:
+        lines.append("(no worker.* metrics yet)")
+    cluster = {k: v for k, v in sorted(snap.items())
+               if k.startswith("cluster.")
+               and isinstance(v, (int, float))}
+    if cluster:
+        lines.append("")
+        lines.append("cluster: " + "  ".join(
+            f"{k[len('cluster.'):]}={v}" for k, v in cluster.items()))
+    return "\n".join(lines)
+
+
+def cmd_top(args) -> int:
+    """Live per-worker cluster view (``clonos_tpu top``): poll a
+    JobMaster metrics endpoint's /metrics.json and render slots, sealed/
+    validated epochs, ring occupancy, replay lag, overhead fraction, and
+    last recovery phase times per worker. ``--once`` prints a single
+    snapshot (scriptable); otherwise redraws every ``--interval`` s
+    until interrupted."""
+    import urllib.request
+
+    url = args.endpoint
+    if "://" not in url:
+        url = "http://" + url
+    url = url.rstrip("/") + "/metrics.json"
+
+    def fetch():
+        with urllib.request.urlopen(url, timeout=5.0) as resp:
+            return json.loads(resp.read().decode("utf-8"))
+
+    if args.once:
+        print(_top_table(fetch()))
+        return 0
+    try:
+        while True:
+            frame = _top_table(fetch())
+            sys.stdout.write("\x1b[2J\x1b[H")   # clear + home
+            sys.stdout.write(f"clonos_tpu top — {url} — "
+                             f"{time.strftime('%H:%M:%S')}\n\n")
+            sys.stdout.write(frame + "\n")
+            sys.stdout.flush()
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
 
 
 def cmd_dissect(args) -> int:
@@ -422,6 +594,7 @@ def main(argv=None) -> int:
     pr.add_argument("--trace-dir", default=None,
                     help="record trace spans to trace-run.jsonl here "
                          "(off by default: zero overhead)")
+    _add_profile_args(pr)
     pr.set_defaults(fn=cmd_run)
 
     pi = sub.add_parser("info", help="describe a job graph")
@@ -465,6 +638,10 @@ def main(argv=None) -> int:
     pw.add_argument("--trace-dir", default=None,
                     help="record trace spans to "
                          "trace-<executor-id>.jsonl here")
+    pw.add_argument("--profile", action="store_true",
+                    help="attribute fault-tolerance overhead per section "
+                         "(overhead.* metrics; off by default: zero "
+                         "overhead, async dispatch preserved)")
     pw.set_defaults(fn=cmd_worker)
 
     ps = sub.add_parser("slotworker",
@@ -491,6 +668,7 @@ def main(argv=None) -> int:
                          "trace-<executor-id>.jsonl here; DEPLOY "
                          "headers make the spans join the JobMaster's "
                          "trace id (off by default: zero overhead)")
+    _add_profile_args(ps)
     ps.set_defaults(fn=cmd_slotworker)
 
     pt = sub.add_parser("trace", help="summarize or convert recorded "
@@ -519,7 +697,22 @@ def main(argv=None) -> int:
                          "group")
     pa.add_argument("--json", action="store_true",
                     help="dump raw ledger entries as JSON")
+    pa.add_argument("--report", choices=["json"], default=None,
+                    help="machine-readable summary for CI: one JSON "
+                         "line {match, groups, problems}; exit code "
+                         "stays 0 on match / 1 on divergence")
     pa.set_defaults(fn=cmd_audit)
+
+    pp = sub.add_parser("top", help="live per-worker cluster view from "
+                                    "a JobMaster metrics endpoint")
+    pp.add_argument("endpoint",
+                    help="metrics endpoint, host:port or http://... "
+                         "(the server started with --metrics-port)")
+    pp.add_argument("--once", action="store_true",
+                    help="print one snapshot and exit (scriptable)")
+    pp.add_argument("--interval", type=float, default=2.0,
+                    help="redraw period in live mode (seconds)")
+    pp.set_defaults(fn=cmd_top)
 
     px = sub.add_parser("dissect", help="dissect warm-replay wall time "
                                         "at bench shapes")

@@ -160,3 +160,24 @@ AUDIT_ON_DIVERGENCE = ConfigOption(
                 "'abort' additionally fails the recovery "
                 "(AuditDivergenceError) before the job resumes on "
                 "non-reproduced state.")
+
+PROFILE_ENABLED = ConfigOption(
+    "observability.profile.enabled", False,
+    description="Attribute per-section fault-tolerance overhead "
+                "(overhead.<section>-ms histograms + the "
+                "overhead.ft-fraction gauge) with device-fenced section "
+                "timers in the hot paths. Off = the NullProfiler: no "
+                "fencing, no per-step host work.")
+
+METRICS_HISTORY_INTERVAL_S = ConfigOption(
+    "observability.metrics-history.interval-s", 2.0,
+    validator=lambda v: v > 0,
+    description="Seconds between metrics-history samples taken by the "
+                "metrics endpoint's sampler thread (served at "
+                "/metrics/history.json).")
+
+METRICS_HISTORY_WINDOW = ConfigOption(
+    "observability.metrics-history.window", 512,
+    validator=lambda v: v > 0,
+    description="Samples retained in the metrics-history ring (memory and "
+                "the bounded history JSONL file alike).")

@@ -20,9 +20,15 @@ from .chrome import (load_jsonl, summarize, to_chrome,  # noqa: F401
 from .digest import EpochDigest, diff, diff_ledgers  # noqa: F401
 from .audit import (Auditor, NullAuditor, configure_audit,  # noqa: F401
                     digest_epoch_window, get_auditor, reset_audit)
+from .profile import (NullProfiler, Profiler, configure_profile,  # noqa: F401
+                      get_profiler, reset_profile)
+from .history import MetricsHistory, read_history_file  # noqa: F401
 
 __all__ = ["Tracer", "NullTracer", "get_tracer", "configure", "reset",
            "load_jsonl", "to_chrome", "validate_chrome", "summarize",
            "EpochDigest", "diff", "diff_ledgers",
            "Auditor", "NullAuditor", "get_auditor", "configure_audit",
-           "reset_audit", "digest_epoch_window"]
+           "reset_audit", "digest_epoch_window",
+           "Profiler", "NullProfiler", "get_profiler",
+           "configure_profile", "reset_profile",
+           "MetricsHistory", "read_history_file"]

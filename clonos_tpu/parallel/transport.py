@@ -131,6 +131,30 @@ def adopt_audit(header: Dict[str, Any]) -> None:
         configure_audit(on_divergence=ctx.get("on_divergence", "warn"))
 
 
+# --- profile-context convention ----------------------------------------------
+# Same shape again, for overhead attribution (obs/profile.py): a
+# JobMaster running with the profiler on stamps DEPLOY headers so every
+# deployed runner attributes its FT overhead — the whole slot pool then
+# reports ``overhead.ft-fraction`` without per-worker flags. A disabled
+# profiler attaches NOTHING: profile-off wire bytes stay identical.
+
+def attach_profile(header: Dict[str, Any]) -> Dict[str, Any]:
+    """Stamp the process profiler's stance on a JSON header (in
+    place)."""
+    from clonos_tpu.obs import get_profiler
+    if get_profiler().enabled:
+        header["profile"] = True
+    return header
+
+
+def adopt_profile(header: Dict[str, Any]) -> None:
+    """Enable process-wide overhead profiling per a received header's
+    ``profile`` field (runners built AFTER adoption inherit)."""
+    from clonos_tpu.obs import configure_profile, get_profiler
+    if header.get("profile") and not get_profiler().enabled:
+        configure_profile()
+
+
 class ControlServer:
     """Threaded request/response endpoint. ``handler(mtype, payload) ->
     (mtype, payload)`` runs per request; one TCP connection may carry many
@@ -152,7 +176,16 @@ class ControlServer:
                             rt, rp = outer._handler(mtype, payload)
                         except Exception as e:       # surface, don't die
                             rt, rp = ERROR, pack_json({"error": str(e)})
-                        _send(self.request, rt, rp)
+                        from clonos_tpu.obs import get_profiler
+                        prof = get_profiler()
+                        if prof.enabled:
+                            # Only the response write: the loop's recv
+                            # blocks waiting for the NEXT request, which
+                            # is idle time, not overhead.
+                            with prof.section("transport-send"):
+                                _send(self.request, rt, rp)
+                        else:
+                            _send(self.request, rt, rp)
                 except (ConnectionError, OSError):
                     return
 
@@ -187,12 +220,22 @@ class ControlClient:
     def call(self, mtype: int, payload: bytes = b"") -> Tuple[int, bytes]:
         if self._closed:
             raise RuntimeError("ControlClient is closed")
+        from clonos_tpu.obs import get_profiler
+        prof = get_profiler()
         try:
             if self._sock is None:
                 self._sock = socket.create_connection(
                     self._address, timeout=self._timeout)
-            _send(self._sock, mtype, payload)
-            return _recv(self._sock)
+            if not prof.enabled:
+                _send(self._sock, mtype, payload)
+                return _recv(self._sock)
+            # Attributed control-plane cost: the request write and the
+            # blocking wait for the peer's response (the client holds
+            # its thread for both legs).
+            with prof.section("transport-send"):
+                _send(self._sock, mtype, payload)
+            with prof.section("transport-recv"):
+                return _recv(self._sock)
         except OSError:
             self._drop()
             raise

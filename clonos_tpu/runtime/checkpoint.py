@@ -92,8 +92,9 @@ class CheckpointStorage:
     # Default: in-memory. Ledger entries are tiny (per-epoch digest
     # summaries) and, unlike snapshots, are NEVER deleted by retention —
     # a later recovery must be able to validate any epoch at/after the
-    # restore point, and cross-run diffing wants the whole history
-    # (compaction is a ROADMAP open item).
+    # restore point, and cross-run diffing wants the whole history.
+    # Completion-driven compaction (below) collapses re-sealed
+    # duplicates so a long run's ledger stays one line per epoch.
 
     def write_ledger(self, entry: dict) -> None:
         if not hasattr(self, "_ledger"):
@@ -102,6 +103,21 @@ class CheckpointStorage:
 
     def read_ledger(self) -> List[dict]:
         return [dict(e) for e in getattr(self, "_ledger", [])]
+
+    def compact_ledger(self, below_epoch: int) -> int:
+        """Collapse entries for epochs strictly below ``below_epoch``
+        (the latest completed fence) to one per epoch, last-wins — a
+        rebuilt runner re-seals replayed epochs, so a long run with
+        failures accumulates duplicates the readers resolve last-wins
+        anyway. Returns the number of entries dropped."""
+        led = getattr(self, "_ledger", None)
+        if not led:
+            return 0
+        compacted = compact_ledger_entries(led, below_epoch)
+        dropped = len(led) - len(compacted)
+        if dropped:
+            self._ledger = compacted
+        return dropped
 
 
 class InMemoryCheckpointStorage(CheckpointStorage):
@@ -182,6 +198,50 @@ class FileCheckpointStorage(CheckpointStorage):
 
     def read_ledger(self) -> List[dict]:
         return read_ledger_file(self.ledger_path())
+
+    def compact_ledger(self, below_epoch: int) -> int:
+        """Atomic last-wins rewrite of ledger.jsonl entries below the
+        fence (tmp + ``os.replace``: a crash mid-compaction leaves the
+        old file or the new one, never a mix). Torn final lines are
+        dropped by the tolerant read, which is also a compaction."""
+        import json
+        path = self.ledger_path()
+        entries = read_ledger_file(path)
+        if not entries:
+            return 0
+        compacted = compact_ledger_entries(entries, below_epoch)
+        dropped = len(entries) - len(compacted)
+        if dropped == 0:
+            return 0
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            for e in compacted:
+                f.write(json.dumps(e, sort_keys=True) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        return dropped
+
+
+def compact_ledger_entries(entries: List[dict],
+                           below_epoch: int) -> List[dict]:
+    """Pure compaction: entries for epochs < ``below_epoch`` collapse
+    to one per epoch (last wins, the readers' resolution rule),
+    emitted in epoch order; everything at/above the fence — including
+    entries without a parseable epoch — keeps its append order after
+    them (later re-seals of live epochs must stay last)."""
+    last: Dict[int, dict] = {}
+    tail: List[dict] = []
+    for e in entries:
+        try:
+            ep: Optional[int] = int(e["epoch"])
+        except (KeyError, TypeError, ValueError):
+            ep = None
+        if ep is not None and ep < below_epoch:
+            last[ep] = e
+        else:
+            tail.append(e)
+    return [last[ep] for ep in sorted(last)] + tail
 
 
 def read_ledger_file(path: str) -> List[dict]:
@@ -356,6 +416,11 @@ class CheckpointCoordinator:
             for fn in self._listeners:
                 fn(ckpt)
             self._retain()
+            # Completion == truncation time: collapse re-sealed ledger
+            # duplicates below this fence so the ledger stays one line
+            # per epoch for the life of the job.
+            with self._writer_lock:
+                self.storage.compact_ledger(checkpoint_id)
 
     def _retain(self) -> None:
         while len(self._completed_ids) > self.max_retained:

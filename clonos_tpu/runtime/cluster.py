@@ -276,6 +276,15 @@ class ClusterRunner:
         self._m_audit_sealed = g.counter("audit.epochs-sealed")
         self._m_audit_matches = g.counter("audit.epochs-validated")
         self._m_audit_div = g.counter("audit.divergences")
+        # Overhead attribution (obs/profile.py): the runner inherits the
+        # process-global profiler (set by config/CLI). Binding routes
+        # the overhead.<section>-ms histograms and overhead.ft-fraction
+        # gauge into this registry so they ride the heartbeat piggyback;
+        # the default NullProfiler binds to nothing and fences nothing.
+        from clonos_tpu.obs import profile as _prof_mod
+        self.profiler = _prof_mod.get_profiler()
+        if self.profiler.enabled:
+            self.profiler.bind(g)
         g.gauge("audit.enabled", lambda: int(self.auditor.enabled))
         g.gauge("audit.last-sealed-epoch", lambda: self.auditor.last_epoch)
         # Live exactly-once health: how hard the in-flight rings are
@@ -693,6 +702,12 @@ class ClusterRunner:
         return svc
 
     def _advance_timers(self, now: int, stamp: int) -> None:
+        if self.profiler.enabled and self.timer_services:
+            with self.profiler.section("timer-advance"):
+                for flat, svc in self.timer_services.items():
+                    if flat not in self.failed:
+                        svc.advance(now, stamp)
+            return
         for flat, svc in self.timer_services.items():
             if flat not in self.failed:
                 svc.advance(now, stamp)
@@ -726,6 +741,10 @@ class ClusterRunner:
         if cfg.get(D.AUDIT_ENABLED):
             kw["audit"] = True
             kw["audit_on_divergence"] = cfg.get(D.AUDIT_ON_DIVERGENCE)
+        if cfg.get(D.PROFILE_ENABLED):
+            from clonos_tpu.obs import profile as _prof
+            if not _prof.get_profiler().enabled:
+                _prof.configure_profile()
         kw.update(overrides)
         runner = cls(job, **kw)
         runner.coordinator.backoff_multiplier = cfg.get(
@@ -771,6 +790,22 @@ class ClusterRunner:
                     "bootstrap_standby: rebalance edges not supported "
                     "(post-replay round-robin cursors are not "
                     "reconstructible from the fence snapshot)")
+        # Rebuild-stage sub-attribution: the stages around recover() are
+        # the standby-host analog of the finalize phase (everything that
+        # must happen besides replay before the job resumes). Each stage
+        # emits a recovery.finalize.<stage> complete under the adopted
+        # recovery trace id and folds into the report's phase_ms.
+        tr = get_tracer()
+        sub_ms: Dict[str, float] = {}
+        t_sub = _time.monotonic()
+
+        def _stage(name: str) -> None:
+            nonlocal t_sub
+            now = _time.monotonic()
+            sub_ms[name] = sub_ms.get(name, 0.0) + (now - t_sub) * 1e3
+            tr.complete(f"recovery.{name}", now - t_sub)
+            t_sub = now
+
         runner = cls(job, checkpoint_dir=checkpoint_dir, **runner_kw)
         for vid, reader in (feed_readers or {}).items():
             runner.executor.register_feed(vid, reader)
@@ -857,6 +892,7 @@ class ClusterRunner:
         runner._ring_tail_mirror = fence
         runner._ck_log_heads[ckpt.checkpoint_id] = np.asarray(
             ckpt.carry.log_heads).astype(np.int64)
+        _stage("finalize.state-rehydrate")
 
         # Roll-gap / async ledgers, re-derived from the mirrored streams:
         # rows between one epoch's last sync block and the next epoch's
@@ -888,6 +924,7 @@ class ClusterRunner:
                 if total_async > 0:
                     runner.executor.async_counts[
                         (flat, from_epoch + j)] = total_async
+        _stage("finalize.listener-reattach")
 
         # In-flight ring offsets/epoch index as the dead worker had them:
         # content is rebuilt by the per-vertex ring write-backs during
@@ -908,6 +945,7 @@ class ClusterRunner:
                 latest_epoch=jnp.asarray(from_epoch + k, jnp.int32),
                 epoch_base=jnp.asarray(from_epoch, jnp.int32)))
         runner.executor.carry = c._replace(out_rings=tuple(new_rings))
+        _stage("finalize.ring-reregister")
 
         # Everything is failed; recover() rebuilds it all from the
         # checkpoint + mirror rows, in topological order.
@@ -915,6 +953,7 @@ class ClusterRunner:
         for f in range(L):
             runner.heartbeats.mark_dead(f)
         report = runner.recover(host_rows=mirror_rows)
+        t_sub = _time.monotonic()    # recover() attributes its own time
 
         # The depth-1 edge buffers (the in-flight batch produced at step
         # fence+n-1, consumed by the NEXT live step) are not part of
@@ -956,6 +995,15 @@ class ClusterRunner:
         ex._rng = np.random.RandomState(ex._seed)
         for _ in range(fence + n_steps):
             ex._rng.randint(0, 2 ** 31, dtype=np.int64)
+        _stage("finalize.first-step-recompile")
+        # Fold the rebuild stages into the report: they extend the
+        # finalize phase (everything-after-replay), so the named
+        # finalize.* sub-spans still sum to the finalize total.
+        for name, ms in sub_ms.items():
+            report.phase_ms[name] = report.phase_ms.get(name, 0.0) + ms
+            report.phase_ms["finalize"] = (
+                report.phase_ms.get("finalize", 0.0) + ms)
+            runner._mgroup.histogram(f"recovery.{name}-ms").update(ms)
         return runner, report
 
     @classmethod
@@ -1104,14 +1152,20 @@ class ClusterRunner:
         closed = self.executor.epoch_id
         n = self.executor.steps_per_epoch - self.executor.step_in_epoch
         tr = get_tracer()
+        prof = self.profiler
         epoch_span = tr.span("epoch", epoch=closed, steps=n)
         epoch_span.__enter__()
         try:
             t0 = _time.monotonic()
             self.executor.run_epoch()
+            # Enabled profiler: fence the carry so "compute" measures
+            # execution, not dispatch (the fused block program = user
+            # compute + in-program causal/ring appends).
+            prof.fence(self.executor.carry)
             steps_s = _time.monotonic() - t0
             self._m_epoch_steps_ms.update(steps_s * 1e3)
             tr.complete("epoch.steps", steps_s, epoch=closed, steps=n)
+            prof.observe("compute", steps_s, kind="compute")
             t_fence = _time.monotonic()
             self.global_step += n
             self._fence_step[self.executor.epoch_id] = self.global_step
@@ -1121,7 +1175,8 @@ class ClusterRunner:
             # One fused device read per epoch: overflow flags + record
             # total + fence log heads (the tunnel round-trip is the cost
             # unit here, not device work).
-            vec = self.executor.health_vector()
+            with prof.section("health-read"):
+                vec = self.executor.health_vector()
             nf = 4 + len(self.executor.carry.out_rings)
             total_records = int(vec[nf])
             # The heads at this fence ARE checkpoint ``closed``'s log
@@ -1159,17 +1214,21 @@ class ClusterRunner:
             # end, so the seal is fence-exact.
             if self.auditor.enabled:
                 from clonos_tpu.obs import audit as _audit_mod
-                dg = _audit_mod.digest_epoch_window(
-                    closed, self.executor.epoch_window(closed))
-                self.auditor.seal(dg)
-                self.coordinator.record_ledger(dg.to_entry())
+                with prof.section("digest-seal"):
+                    dg = _audit_mod.digest_epoch_window(
+                        closed, self.executor.epoch_window(closed))
+                    self.auditor.seal(dg)
+                with prof.section("ledger-write"):
+                    self.coordinator.record_ledger(dg.to_entry())
                 self.epoch_tracker.notify_epoch_sealed(closed, dg)
                 self._m_audit_sealed.inc()
             # Checkpoint at the fence: the lean fence snapshot (op state
             # + offsets; logs/rings are truncated on completion, not
             # persisted).
-            self.coordinator.trigger(closed, self.executor.lean_snapshot(),
-                                     async_write=False, owned=True)
+            with prof.section("snapshot"):
+                self.coordinator.trigger(
+                    closed, self.executor.lean_snapshot(),
+                    async_write=False, owned=True)
             # The checkpoint-trigger RPC arrival is nondeterministic in
             # the reference and logged by every source
             # (StreamTask.performCheckpoint:833-840); fence-aligned here,
@@ -1181,11 +1240,14 @@ class ClusterRunner:
             if self._source_flats:
                 t_ms = (self.executor.step_input_history[-1][0]
                         if self.executor.step_input_history else 0)
-                self.executor.append_async_many(
-                    self._source_flats,
-                    det.SourceCheckpointDeterminant(
-                        record_count=self.executor.global_record_stamp(),
-                        checkpoint_id=closed, timestamp=t_ms))
+                with prof.section("source-append"):
+                    self.executor.append_async_many(
+                        self._source_flats,
+                        det.SourceCheckpointDeterminant(
+                            record_count=(
+                                self.executor.global_record_stamp()),
+                            checkpoint_id=closed, timestamp=t_ms))
+                    prof.fence(self.executor.carry.logs)
             for tl in self.txn_logs.values():
                 tl.seal(closed)
             # Before completion: ack_all truncates rings up to this
@@ -1198,6 +1260,10 @@ class ClusterRunner:
             fence_s = _time.monotonic() - t_fence
             self._m_epoch_fence_ms.update(fence_s * 1e3)
             tr.complete("epoch.fence", fence_s, epoch=closed)
+            # Close the attribution window: FT seconds / (FT + compute)
+            # since the previous fence -> the overhead.ft-fraction
+            # gauge (a no-op returning 0.0 on the NullProfiler).
+            prof.rollup()
         except BaseException as e:
             epoch_span.__exit__(type(e), e, e.__traceback__)
             raise
@@ -1687,6 +1753,14 @@ class ClusterRunner:
         # on-device output-cut verification flag, and its consumed total.
         # TPU programs execute in dispatch order, so this read — dispatched
         # last — is also the barrier the old device_sync(patched) was.
+        # Sub-attribution (the bench's one-number "finalize" mystery):
+        # ``finalize.barrier-read`` = the packed concatenate + d2h
+        # transfer (dispatch-order barrier: it pays for every program
+        # still in flight), ``finalize.state-verify`` = the host-side
+        # deferred asserts. The two partition the finalize phase
+        # exactly, land in RecoveryReport.phase_ms next to it, and
+        # emit under the same recovery trace id.
+        ts = tp
         fast_mgrs = [m for m in managers if prep[m.flat_subtask]["fast"]]
         fl_d = jnp.asarray(list(failed), jnp.int32)
         pieces = [patched.logs.head[fl_d].astype(jnp.int32)]
@@ -1700,6 +1774,7 @@ class ClusterRunner:
                 m.result.verify_ok_d.astype(jnp.int32).reshape(1),
                 m.result.consumed_d.astype(jnp.int32).reshape(1)]
         arr_f = np.asarray(jnp.concatenate(pieces))
+        ts = _clock("finalize.barrier-read", ts)
         off_f = len(failed)
         heads_after = arr_f[:off_f]
         if nrings:
@@ -1766,6 +1841,7 @@ class ClusterRunner:
                     f"host recheck passed — flag/stream mismatch")
             m.result.records_replayed = consumed_f
             total_records += consumed_f
+        _clock("finalize.state-verify", ts)
         tp = _clock("finalize", tp)
         for flat in failed:
             self.heartbeats.revive(flat)

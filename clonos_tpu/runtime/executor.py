@@ -825,9 +825,14 @@ class LocalExecutor:
         closed = self.epoch_id
         self.epoch_id += 1
         self.step_in_epoch = 0
+        from clonos_tpu.obs import get_profiler
+        prof = get_profiler()
         if self.spill_logs is not None:
-            self._spill_epoch(closed)
-        self.carry = self._jit_roll(self.carry, self.epoch_id)
+            with prof.section("spill"):
+                self._spill_epoch(closed)
+        with prof.section("roll"):
+            self.carry = self._jit_roll(self.carry, self.epoch_id)
+            prof.fence(self.carry.logs)
         return outs
 
     def _spill_epoch(self, epoch: int) -> None:
@@ -875,16 +880,19 @@ class LocalExecutor:
 
     def notify_checkpoint_complete(self, epoch: int) -> None:
         """Truncate determinant + in-flight logs for epochs <= ``epoch``."""
-        from clonos_tpu.obs import get_tracer
+        from clonos_tpu.obs import get_profiler, get_tracer
         tr = get_tracer()
         if tr.enabled:
             # checkpoint-cadence, not per-step: the epoch fence ->
             # truncation leg of the epoch lifecycle
             tr.event("epoch.inflight_truncate", epoch=epoch)
-        self.carry = self._jit_trunc(self.carry, epoch)
-        if self.spill_logs is not None:
-            for sl in self.spill_logs:
-                sl.truncate(epoch)
+        prof = get_profiler()
+        with prof.section("truncate"):
+            self.carry = self._jit_trunc(self.carry, epoch)
+            prof.fence(self.carry.logs)
+            if self.spill_logs is not None:
+                for sl in self.spill_logs:
+                    sl.truncate(epoch)
         for i, pend in enumerate(self._pending_spill):
             self._pending_spill[i] = [(e, s, m) for (e, s, m) in pend
                                       if e > epoch]
@@ -1053,12 +1061,14 @@ class LocalExecutor:
         rows1[list(flat_subtasks)] = row
         counts[list(flat_subtasks)] = 1
         c = self.carry
-        lr, lh, rr, rh = self._jit_append_many(
-            c.logs.rows, c.logs.head, c.replicas.rows, c.replicas.head,
-            jnp.asarray(rows1), jnp.asarray(counts))
-        self.carry = c._replace(
-            logs=c.logs._replace(rows=lr, head=lh),
-            replicas=c.replicas._replace(rows=rr, head=rh))
+        from clonos_tpu.obs import get_profiler
+        with get_profiler().section("async-append"):
+            lr, lh, rr, rh = self._jit_append_many(
+                c.logs.rows, c.logs.head, c.replicas.rows, c.replicas.head,
+                jnp.asarray(rows1), jnp.asarray(counts))
+            self.carry = c._replace(
+                logs=c.logs._replace(rows=lr, head=lh),
+                replicas=c.replicas._replace(rows=rr, head=rh))
 
     def global_record_stamp(self) -> int:
         """Monotone nonzero stamp for async rows (1 + supersteps run)."""

@@ -135,9 +135,13 @@ class JobMasterServer:
         extra keys."""
         with self._lock:
             snaps = {eid: dict(m) for eid, m in self._hb_metrics.items()}
+            slots = dict(self._slots)
         out = {f"worker.{eid}.{name}": v
                for eid, m in sorted(snaps.items())
                for name, v in m.items()}
+        for eid, n in sorted(slots.items()):
+            if n:  # zero-slot registrants host no tasks — no worker row
+                out[f"worker.{eid}.slots"] = int(n)
         audit = {k: v for k, v in out.items()
                  if ".audit." in k and isinstance(v, (int, float))}
         if audit:
@@ -151,6 +155,17 @@ class JobMasterServer:
             out["cluster.audit.epochs-validated"] = int(validated)
             out["cluster.audit.divergences"] = int(div)
             out["cluster.audit.exactly-once-ok"] = int(div == 0)
+        # Overhead rollup (obs/profile.py rides the same piggyback):
+        # the worst per-worker FT fraction is the cluster's headline
+        # number — overhead hides in the max, not the mean.
+        fracs = [v for k, v in out.items()
+                 if k.endswith("overhead.ft-fraction")
+                 and isinstance(v, (int, float))]
+        if fracs:
+            out["cluster.overhead.ft-fraction-max"] = round(
+                max(fracs), 6)
+            out["cluster.overhead.ft-fraction-mean"] = round(
+                sum(fracs) / len(fracs), 6)
         return out
 
     def expired(self) -> List[str]:

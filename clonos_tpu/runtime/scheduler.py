@@ -581,10 +581,12 @@ class SliceWorker:
         attempt = int(tdd.get("attempt", 0))
         # Join the JobMaster's trace: every span this worker emits from
         # here on (epochs, checkpoints, recovery phases) shares its id.
-        # Likewise its audit stance: a JobMaster with auditing on makes
-        # every deployed runner seal + validate epoch digests.
+        # Likewise its audit stance (a JobMaster with auditing on makes
+        # every deployed runner seal + validate epoch digests) and its
+        # profiling stance (overhead attribution spans the slot pool).
         tp.adopt_trace(tdd)
         tp.adopt_audit(tdd)
+        tp.adopt_profile(tdd)
         tr = get_tracer()
         self._task_state(group, "DEPLOYING", attempt=attempt)
         job = _load_job(tdd["job"])
@@ -843,9 +845,9 @@ class SlotPoolScheduler:
         """Stamp, send, await RUNNING, and wire mirror + exports."""
         attempt = self._attempts.get(group, -1) + 1
         self._attempts[group] = attempt
-        tdd = tp.attach_audit(tp.attach_trace(
+        tdd = tp.attach_profile(tp.attach_audit(tp.attach_trace(
             dict(tdd, attempt=attempt,
-                 fencing_epoch=self.election.epoch)))
+                 fencing_epoch=self.election.epoch))))
         t0 = time.monotonic()
         with get_tracer().span("deploy", group=group, worker=worker_id,
                                attempt=attempt,

@@ -347,15 +347,20 @@ class MetricsEndpoint:
     pairs merged into both metric views — the JobMaster passes its
     aggregated per-worker heartbeat snapshots here so one scrape covers
     the whole cluster. ``tracer`` (any object with ``records()``)
-    backs ``/trace``; without one the path 404s."""
+    backs ``/trace``; without one the path 404s. ``history`` (an
+    ``obs.MetricsHistory``) backs ``/metrics/history.json?since=TS&
+    last=N``; a history without a ``sample_fn`` samples this
+    endpoint's merged view, and an unstarted one is started (and owned
+    — ``close()`` stops it)."""
 
     def __init__(self, registry: MetricRegistry, host: str = "127.0.0.1",
                  port: int = 0,
                  extra: Optional[Callable[[], Dict[str, Any]]] = None,
-                 tracer=None):
+                 tracer=None, history=None):
         import http.server
         import json as _json
         import threading
+        import urllib.parse as _urlparse
 
         reg = registry
 
@@ -368,16 +373,42 @@ class MetricsEndpoint:
                     snap["extra-error"] = repr(e)
             return snap
 
+        self._history = history
+        self._owns_history = False
+        if history is not None:
+            if history.sample_fn is None:
+                history.sample_fn = merged
+            if not history.started:
+                history.start()
+                self._owns_history = True
+
         class H(http.server.BaseHTTPRequestHandler):
             def do_GET(self):
-                if self.path.rstrip("/") == "/metrics":
+                url = _urlparse.urlsplit(self.path)
+                route = url.path.rstrip("/")
+                if route == "/metrics":
                     body = reg.prometheus_text(merged()).encode()
                     ctype = "text/plain; version=0.0.4"
-                elif self.path.rstrip("/") == "/metrics.json":
+                elif route == "/metrics.json":
                     body = _json.dumps(merged(), default=str).encode()
                     ctype = "application/json"
-                elif self.path.rstrip("/") == "/trace" and \
-                        tracer is not None:
+                elif route == "/metrics/history.json" and \
+                        history is not None:
+                    q = _urlparse.parse_qs(url.query)
+
+                    def _num(key, cast):
+                        try:
+                            return cast(q[key][0])
+                        except (KeyError, IndexError, ValueError):
+                            return None
+
+                    body = _json.dumps(
+                        {"samples": history.query(
+                            since=_num("since", float),
+                            last=_num("last", int))},
+                        default=str).encode()
+                    ctype = "application/json"
+                elif route == "/trace" and tracer is not None:
                     from ..obs import chrome as _chrome
                     body = _json.dumps(
                         _chrome.to_chrome(tracer.records())).encode()
@@ -403,3 +434,5 @@ class MetricsEndpoint:
     def close(self) -> None:
         self._srv.shutdown()
         self._srv.server_close()
+        if self._owns_history and self._history is not None:
+            self._history.close()
