@@ -93,6 +93,8 @@ class CausalTimeService(AbstractCausalService):
 
     def __init__(self, append, replay_feed=None, clock=None):
         super().__init__(append, replay_feed)
+        # clonos: allow(wallclock) — this IS the causal clock's source;
+        # every read is logged as a TimestampDeterminant and replayed.
         self._clock = clock or (lambda: int(_time.time() * 1000))
 
     def current_time_millis(self) -> int:

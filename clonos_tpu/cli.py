@@ -406,6 +406,33 @@ def _top_table(snap) -> str:
     return "\n".join(lines)
 
 
+def cmd_lint(args) -> int:
+    """Static determinism lint (``clonos_tpu lint``): check pipeline
+    and runtime code against the causal-services contract — the audit
+    (``clonos_tpu audit``) proves a replay diverged; this names the
+    line that made it diverge, before the job ever runs. Deliberately
+    jax-free: it must be runnable from any CI box."""
+    from clonos_tpu import lint as _lint
+
+    if args.list_rules:
+        for rule in _lint.all_rules():
+            print(f"{rule.name:16} {rule.description}")
+        return 0
+    try:
+        result = _lint.run_lint(args.paths, waiver_file=args.waivers,
+                                use_waivers=not args.no_waivers,
+                                rules=args.rule or None)
+    except ValueError as exc:
+        print(str(exc), file=sys.stderr)
+        return 2
+    if args.report == "json":
+        # CI convention: one machine-readable line, exit 0/1.
+        print(_lint.format_json(result))
+    else:
+        print(_lint.format_text(result, verbose=args.verbose))
+    return result.exit_code()
+
+
 def cmd_top(args) -> int:
     """Live per-worker cluster view (``clonos_tpu top``): poll a
     JobMaster metrics endpoint's /metrics.json and render slots, sealed/
@@ -702,6 +729,33 @@ def main(argv=None) -> int:
                          "line {match, groups, problems}; exit code "
                          "stays 0 on match / 1 on divergence")
     pa.set_defaults(fn=cmd_audit)
+
+    pl = sub.add_parser("lint", help="static determinism lint of "
+                                     "pipeline and runtime code")
+    pl.add_argument("paths", nargs="*",
+                    default=["clonos_tpu", "examples"],
+                    help="files and/or directories to lint (default: "
+                         "clonos_tpu examples); naming a file directly "
+                         "overrides waiver-file `exclude` entries")
+    pl.add_argument("--report", choices=["json"], default=None,
+                    help="machine-readable summary for CI: one JSON "
+                         "line {ok, files, errors, warnings, waived, "
+                         "findings}; exit 0 clean / 1 on findings")
+    pl.add_argument("--waivers", default=None, metavar="FILE",
+                    help="waiver file (default: ./.clonos-waivers if "
+                         "present)")
+    pl.add_argument("--no-waivers", action="store_true",
+                    help="ignore all waivers (inline and file) — show "
+                         "every raw finding")
+    pl.add_argument("--rule", action="append", default=[],
+                    metavar="NAME",
+                    help="restrict to one rule (repeatable); unknown "
+                         "names exit 2")
+    pl.add_argument("--list-rules", action="store_true",
+                    help="print the rule registry and exit")
+    pl.add_argument("-v", "--verbose", action="store_true",
+                    help="also print waived findings")
+    pl.set_defaults(fn=cmd_lint)
 
     pp = sub.add_parser("top", help="live per-worker cluster view from "
                                     "a JobMaster metrics endpoint")

@@ -48,6 +48,8 @@ from typing import Any, Deque, Dict, List, Optional
 
 
 def _new_id() -> str:
+    # clonos: allow(entropy) — trace/span ids are correlation metadata;
+    # they never feed operator state and are not expected to replay.
     return uuid.uuid4().hex[:16]
 
 
@@ -142,6 +144,7 @@ class Tracer:
     enabled = True
 
     def __init__(self, service: str, path: Optional[str] = None,
+                 # clonos: allow(wallclock): span timestamps, obs-only
                  trace_id: Optional[str] = None, clock=time.time,
                  buffer: int = 8192):
         self.service = service

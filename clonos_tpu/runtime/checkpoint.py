@@ -338,6 +338,7 @@ class CheckpointCoordinator:
         if checkpoint_id in self._ignored:
             return
         self._pending[checkpoint_id] = set(range(self.num_subtasks))
+        # clonos: allow(wallclock): trigger->complete latency metric only
         self._trigger_wall[checkpoint_id] = time.time()
         get_tracer().event("checkpoint.trigger", cid=checkpoint_id,
                            subtasks=self.num_subtasks)
@@ -396,13 +397,19 @@ class CheckpointCoordinator:
         if checkpoint_id in self._pending:
             del self._pending[checkpoint_id]
             self._completed_ids.append(checkpoint_id)
-            try:
-                self.storage.mark_complete(checkpoint_id)
-            except NotImplementedError:          # custom storages
-                pass
+            # mark_complete rewrites storage metadata; every other
+            # storage mutation (write/delete/compact_ledger) holds
+            # _writer_lock, and _maybe_complete runs on both the async
+            # writer thread and the caller thread.
+            with self._writer_lock:
+                try:
+                    self.storage.mark_complete(checkpoint_id)
+                except NotImplementedError:      # custom storages
+                    pass
             tr = get_tracer()
             trig = self._trigger_wall.pop(checkpoint_id, None)
             if trig is not None:
+                # clonos: allow(wallclock): completion latency metric
                 lat = time.time() - trig
                 self.completion_latency_s[checkpoint_id] = lat
                 while len(self.completion_latency_s) > 64:

@@ -1932,7 +1932,7 @@ class ClusterRunner:
             holders_per_owner = {}
             for (o, _h) in compiled.plan.pairs:
                 holders_per_owner[o] = holders_per_owner.get(o, 0) + 1
-            for h in set(holders_per_owner.values()):
+            for h in sorted(set(holders_per_owner.values())):
                 self._fetch_meta_fn(h)(carry.replicas, zero((h,)),
                                        jnp.asarray(0, jnp.int32))
             self._log_restore_from_replica_fn()(

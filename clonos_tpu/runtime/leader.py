@@ -49,6 +49,8 @@ class FileLeaderElection:
         #: hosts, across process (and host) restarts — CLOCK_MONOTONIC is
         #: per-boot and means nothing to another reader. The injected
         #: clock exists for tests only.
+        # clonos: allow(wallclock): lease deadlines are cross-host wall
+        # time by design (see note above); leases are never replayed.
         self._clock = time.time if clock is None else clock
         #: fencing token of OUR current leadership (None = not leader)
         self.epoch: Optional[int] = None
@@ -108,6 +110,7 @@ class FileLeaderElection:
         if rec.get("pending"):
             # Grace keyed to wall time (mtime); the injected clock does
             # not apply to a foreign writer mid-create.
+            # clonos: allow(wallclock): expiry of a foreign lease file
             return time.time() > rec["deadline_wall"]
         return self._clock() > rec["deadline_wall"]
 

@@ -252,6 +252,7 @@ class JsonLinesReporter(Reporter):
     """Appends one JSON object per report to a file (the scrape/ship
     boundary for external systems)."""
 
+    # clonos: allow(wallclock): report timestamps for external scrapers
     def __init__(self, path: str, clock=time.time):
         self._path = path
         self._clock = clock

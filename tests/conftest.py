@@ -34,18 +34,24 @@ _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def pytest_configure(config):
-    """Register this repo's markers (tools/check_markers.py is the
-    single source of truth) and lint the suite for unregistered ones —
-    a typo'd marker is a silent no-op under ``-m 'not slow'``, so it
-    fails the session here instead."""
-    import sys
-    sys.path.insert(0, os.path.join(_REPO_ROOT, "tools"))
-    try:
-        import check_markers
-    finally:
-        sys.path.pop(0)
-    for name, help_text in check_markers.REGISTERED_MARKERS.items():
+    """Register this repo's markers (clonos_tpu/lint/markers.py is the
+    single source of truth) and run the full determinism lint — a
+    typo'd marker is a silent no-op under ``-m 'not slow'``, and an
+    unlogged time.time() is a replay divergence waiting for a failure
+    to surface it, so both fail the session here with file:line
+    findings instead."""
+    from clonos_tpu.lint import format_text, run_lint
+    from clonos_tpu.lint.markers import REGISTERED_MARKERS
+
+    for name, help_text in REGISTERED_MARKERS.items():
         config.addinivalue_line("markers", f"{name}: {help_text}")
-    violations = check_markers.check(os.path.join(_REPO_ROOT, "tests"))
-    if violations:
-        raise pytest.UsageError("\n".join(violations))
+    cwd = os.getcwd()
+    os.chdir(_REPO_ROOT)   # finding paths & waiver globs repo-relative
+    try:
+        result = run_lint(["clonos_tpu", "examples", "tests"])
+    finally:
+        os.chdir(cwd)
+    if not result.ok:
+        raise pytest.UsageError(
+            "determinism lint failed (clonos_tpu lint):\n"
+            + format_text(result))

@@ -1,0 +1,534 @@
+"""Static determinism lint (clonos_tpu/lint/): rules, waivers, CLI.
+
+The acceptance pair: ``clonos_tpu lint clonos_tpu/ examples/`` exits 0
+on the repo (every exemption explicit), and pointed straight at
+``examples/audit_nondet.py`` exits 1 naming the exact line of the
+unlogged SALT — the same bug the PR-3 runtime audit catches as a
+digest divergence, which test_same_bug_static_and_runtime pairs up.
+
+NOTE: this file is itself linted at session configure (markers rule is
+line-regex based), so unregistered-marker fixtures below are built by
+string concatenation, never written literally.
+"""
+
+import importlib.util
+import json
+import os
+import textwrap
+
+import pytest
+
+from clonos_tpu.lint import (ERROR, WARNING, RULES, FileContext,
+                             rule_names, run_lint)
+from clonos_tpu.lint.runner import collect_files, format_json
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _lint_src(tmp_path, monkeypatch, src, name="mod.py",
+              waiver_text=None, use_waivers=True, rules=None):
+    monkeypatch.chdir(tmp_path)
+    (tmp_path / name).write_text(textwrap.dedent(src))
+    if waiver_text is not None:
+        (tmp_path / ".clonos-waivers").write_text(
+            textwrap.dedent(waiver_text))
+    return run_lint([name], use_waivers=use_waivers, rules=rules)
+
+
+def _hits(result, rule):
+    return [f for f in result.findings if f.rule == rule]
+
+
+# --- rule family 1: nondeterminism escapes -------------------------------
+
+
+def test_wallclock_flags_aliased_import(tmp_path, monkeypatch):
+    res = _lint_src(tmp_path, monkeypatch, """\
+        import time as _t
+        def now():
+            return _t.time()
+        """, use_waivers=False)
+    (f,) = _hits(res, "wallclock")
+    assert f.line == 3 and "causal time service" in f.message
+    assert res.exit_code() == 1
+
+
+def test_wallclock_reference_without_call_flagged(tmp_path, monkeypatch):
+    # `clock=time.time` stashes the wall clock as surely as calling it.
+    res = _lint_src(tmp_path, monkeypatch, """\
+        import time
+        def mk(clock=time.time):
+            return clock
+        """, use_waivers=False)
+    assert len(_hits(res, "wallclock")) == 1
+
+
+def test_monotonic_not_flagged(tmp_path, monkeypatch):
+    # Durations are not replayed data; time.monotonic is fine.
+    res = _lint_src(tmp_path, monkeypatch, """\
+        import time
+        def span():
+            t0 = time.monotonic()
+            return time.monotonic() - t0
+        """, use_waivers=False)
+    assert res.ok
+
+
+def test_rng_global_draw_and_unseeded_ctor(tmp_path, monkeypatch):
+    res = _lint_src(tmp_path, monkeypatch, """\
+        import random
+        import numpy as np
+        def a():
+            return random.random()
+        def b():
+            return np.random.rand(3)
+        def c():
+            return np.random.RandomState()
+        """, use_waivers=False)
+    assert len(_hits(res, "rng")) == 3
+
+
+def test_rng_seeded_ctor_is_deterministic(tmp_path, monkeypatch):
+    res = _lint_src(tmp_path, monkeypatch, """\
+        import numpy as np
+        def mk(seed):
+            return np.random.RandomState(seed)
+        """, use_waivers=False)
+    assert res.ok
+
+
+def test_entropy_urandom_and_uuid(tmp_path, monkeypatch):
+    res = _lint_src(tmp_path, monkeypatch, """\
+        import os
+        import uuid
+        SALT = int.from_bytes(os.urandom(3), "little")
+        TAG = uuid.uuid4().hex
+        """, use_waivers=False)
+    assert {f.line for f in _hits(res, "entropy")} == {3, 4}
+
+
+def test_unordered_iter_set_flagged_sorted_ok(tmp_path, monkeypatch):
+    res = _lint_src(tmp_path, monkeypatch, """\
+        def bad(xs, out):
+            for x in set(xs):
+                out.append(x)
+        def good(xs, out):
+            for x in sorted(set(xs)):
+                out.append(x)
+        def comp(xs):
+            return [x for x in {1, 2, 3}]
+        """, use_waivers=False)
+    assert {f.line for f in _hits(res, "unordered-iter")} == {2, 8}
+
+
+# --- rule family 2: trace safety -----------------------------------------
+
+
+def test_host_branch_on_traced_param(tmp_path, monkeypatch):
+    res = _lint_src(tmp_path, monkeypatch, """\
+        class Op:
+            def process(self, state, batch):
+                if batch > 0:
+                    return state
+                return state + 1
+        """, use_waivers=False)
+    (f,) = _hits(res, "host-branch")
+    assert f.line == 3 and "batch" in f.message
+
+
+def test_host_branch_static_shape_exempt(tmp_path, monkeypatch):
+    # .shape/.dtype are static at trace time — not a host branch on a
+    # traced VALUE; and self-config branches are static too.
+    res = _lint_src(tmp_path, monkeypatch, """\
+        class Op:
+            def process(self, state, batch):
+                if batch.shape[0] == 8:
+                    return state
+                if self.fancy:
+                    return state
+                return state
+        """, use_waivers=False)
+    assert res.ok
+
+
+def test_host_branch_in_map_lambda(tmp_path, monkeypatch):
+    res = _lint_src(tmp_path, monkeypatch, """\
+        def build(env):
+            return env.map(lambda k, v, t: v if v > 0 else -v)
+        """, use_waivers=False)
+    assert len(_hits(res, "host-branch")) == 1
+
+
+def test_mutable_closure_capture(tmp_path, monkeypatch):
+    res = _lint_src(tmp_path, monkeypatch, """\
+        seen = []
+        class Op:
+            def process(self, state, batch):
+                seen.append(batch)
+                return state
+        """, use_waivers=False)
+    (f,) = _hits(res, "mutable-closure")
+    assert "seen" in f.message
+
+
+def test_mutable_local_ok(tmp_path, monkeypatch):
+    res = _lint_src(tmp_path, monkeypatch, """\
+        class Op:
+            def process(self, state, batch):
+                acc = []
+                acc.append(batch)
+                return state
+        """, use_waivers=False)
+    assert res.ok
+
+
+def test_host_callback_and_item_sync(tmp_path, monkeypatch):
+    res = _lint_src(tmp_path, monkeypatch, """\
+        class Op:
+            def process(self, state, batch):
+                print(batch)
+                x = batch.item()
+                return state
+        """, use_waivers=False)
+    assert len(_hits(res, "host-callback")) == 2
+
+
+def test_plain_methods_not_traced(tmp_path, monkeypatch):
+    # Only step-function entry points are traced scopes.
+    res = _lint_src(tmp_path, monkeypatch, """\
+        class Helper:
+            def run(self, batch):
+                if batch > 0:
+                    print(batch)
+                return batch
+        """, use_waivers=False)
+    assert res.ok
+
+
+# --- rule family 3: lock discipline --------------------------------------
+
+
+def test_lock_discipline_unlocked_mutation(tmp_path, monkeypatch):
+    res = _lint_src(tmp_path, monkeypatch, """\
+        import threading
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = []
+            def put(self, x):
+                with self._lock:
+                    self._items.append(x)
+            def race(self, x):
+                self._items.append(x)
+        """, use_waivers=False)
+    (f,) = _hits(res, "lock-discipline")
+    assert f.line == 10 and "_items" in f.message
+
+
+def test_lock_discipline_helper_called_under_lock_ok(tmp_path,
+                                                     monkeypatch):
+    # A helper only ever reached with the lock held is lock-held
+    # itself (the _trim_to pattern in api/feeds.py).
+    res = _lint_src(tmp_path, monkeypatch, """\
+        import threading
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = []
+            def put(self, x):
+                with self._lock:
+                    self._items.append(x)
+                    self._fill(x)
+            def _fill(self, x):
+                self._items.append(x)
+            def drain_locked(self):
+                self._items.clear()
+        """, use_waivers=False)
+    assert res.ok
+
+
+def test_lock_discipline_init_exempt_and_unlocked_class_quiet(
+        tmp_path, monkeypatch):
+    res = _lint_src(tmp_path, monkeypatch, """\
+        class NoLocks:
+            def __init__(self):
+                self._items = []
+            def put(self, x):
+                self._items.append(x)
+        """, use_waivers=False)
+    assert res.ok
+
+
+# --- waivers --------------------------------------------------------------
+
+
+def test_inline_waiver_same_line(tmp_path, monkeypatch):
+    res = _lint_src(tmp_path, monkeypatch, """\
+        import time
+        STARTED = time.time()  # clonos: allow(wallclock) banner only
+        """)
+    assert res.ok and len(res.waived) == 1
+
+
+def test_inline_waiver_comment_block_above(tmp_path, monkeypatch):
+    # A multi-line justification block waives the next CODE line.
+    res = _lint_src(tmp_path, monkeypatch, """\
+        import time
+        # clonos: allow(wallclock) — process start banner,
+        # never replayed data.
+        STARTED = time.time()
+        """)
+    assert res.ok and len(res.waived) == 1
+
+
+def test_inline_waiver_in_string_is_documentation(tmp_path, monkeypatch):
+    # Waiver syntax quoted in a docstring must not waive anything.
+    res = _lint_src(tmp_path, monkeypatch, '''\
+        """Docs: write `# clonos: allow(wallclock)` to waive."""
+        import time
+        STARTED = time.time()
+        ''')
+    assert not res.ok and not res.waived
+
+
+def test_waiver_file_rule_glob(tmp_path, monkeypatch):
+    res = _lint_src(tmp_path, monkeypatch, """\
+        import time
+        STARTED = time.time()
+        """, waiver_text="wallclock mod.py\n")
+    assert res.ok and len(res.waived) == 1
+
+
+def test_unknown_rule_inline_is_error(tmp_path, monkeypatch):
+    res = _lint_src(tmp_path, monkeypatch, """\
+        import time
+        STARTED = time.time()  # clonos: allow(wallclok) typo
+        """)
+    errs = _hits(res, "waiver-unknown-rule")
+    assert len(errs) == 1 and "wallclok" in errs[0].message
+    assert res.exit_code() == 1
+
+
+def test_unknown_rule_in_waiver_file_is_error(tmp_path, monkeypatch):
+    res = _lint_src(tmp_path, monkeypatch, "X = 1\n",
+                    waiver_text="wallclok mod.py\n")
+    errs = _hits(res, "waiver-unknown-rule")
+    assert len(errs) == 1 and res.exit_code() == 1
+
+
+def test_stale_inline_waiver_warns_exit_zero(tmp_path, monkeypatch):
+    res = _lint_src(tmp_path, monkeypatch, """\
+        X = 1  # clonos: allow(wallclock) nothing here any more
+        """)
+    (w,) = _hits(res, "stale-waiver")
+    assert w.severity == WARNING
+    assert res.exit_code() == 0
+
+
+def test_stale_waiver_file_entry_warns(tmp_path, monkeypatch):
+    res = _lint_src(tmp_path, monkeypatch, "X = 1\n",
+                    waiver_text="entropy other_*.py\n")
+    (w,) = _hits(res, "stale-waiver")
+    assert ".clonos-waivers" in w.path and res.exit_code() == 0
+
+
+def test_exclude_skips_traversal_but_not_explicit_target(
+        tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    (tmp_path / "pkg").mkdir()
+    (tmp_path / "pkg" / "bait.py").write_text(
+        "import os\nS = os.urandom(3)\n")
+    (tmp_path / "pkg" / "clean.py").write_text("X = 1\n")
+    (tmp_path / ".clonos-waivers").write_text("exclude pkg/bait.py\n")
+    # Directory traversal: bait excluded, tree is clean.
+    res = run_lint(["pkg"])
+    assert res.ok and res.files == ["pkg/clean.py"]
+    # Naming the file is the override: finding comes back, no stale
+    # warning for the exclude that was deliberately bypassed.
+    res2 = run_lint(["pkg/bait.py"])
+    assert res2.exit_code() == 1
+    assert not _hits(res2, "stale-waiver")
+
+
+def test_no_waivers_flag_shows_raw_findings(tmp_path, monkeypatch):
+    res = _lint_src(tmp_path, monkeypatch, """\
+        import time
+        STARTED = time.time()  # clonos: allow(wallclock) reason
+        """, use_waivers=False)
+    assert res.exit_code() == 1 and not res.waived
+
+
+def test_unknown_rule_filter_raises(tmp_path, monkeypatch):
+    with pytest.raises(ValueError, match="no-such-rule"):
+        _lint_src(tmp_path, monkeypatch, "X = 1\n",
+                  rules=["no-such-rule"])
+
+
+# --- registry -------------------------------------------------------------
+
+
+def test_registry_contents_and_custom_rule():
+    assert {"wallclock", "rng", "entropy", "unordered-iter",
+            "host-branch", "mutable-closure", "host-callback",
+            "lock-discipline", "markers"} <= set(rule_names())
+
+    from clonos_tpu.lint import Rule, register_rule
+
+    class NoTodo(Rule):
+        name = "no-todo-test-rule"
+        description = "test-only rule"
+
+        def check(self, ctx):
+            return [self.finding(ctx, i, "todo")
+                    for i, line in enumerate(ctx.lines, 1)
+                    if "TODO" in line]
+
+    try:
+        register_rule(NoTodo)
+        assert "no-todo-test-rule" in RULES
+        ctx = FileContext("x.py", "A = 1  # TODO later\n")
+        assert len(RULES["no-todo-test-rule"].check(ctx)) == 1
+        with pytest.raises(ValueError, match="duplicate"):
+            register_rule(NoTodo)
+    finally:
+        RULES.pop("no-todo-test-rule", None)
+
+
+# --- the repo itself ------------------------------------------------------
+
+
+def test_self_lint_repo_clean(monkeypatch):
+    """The tree lints clean with every exemption explicit (satellite:
+    self-lint), and the bait file is excluded from traversal only."""
+    monkeypatch.chdir(_REPO)
+    res = run_lint(["clonos_tpu", "examples"])
+    assert res.ok, "\n".join(
+        f.location() + " " + f.message for f in res.errors)
+    assert res.waived, "expected explicit waivers, found none"
+    assert not res.warnings
+
+
+def test_examples_wordcount_nexmark_clean(monkeypatch):
+    monkeypatch.chdir(_REPO)
+    res = run_lint(["examples/wordcount.py", "examples/nexmark_join.py"])
+    assert res.ok and not res.findings
+
+
+def test_audit_nondet_flagged_at_salt_line(monkeypatch):
+    monkeypatch.chdir(_REPO)
+    with open(os.path.join(_REPO, "examples", "audit_nondet.py")) as f:
+        src = f.read()
+    salt_line = 1 + next(i for i, l in enumerate(src.splitlines())
+                         if "os.urandom" in l)
+    res = run_lint(["examples/audit_nondet.py"])
+    (f,) = res.errors
+    assert (f.rule, f.path, f.line) == (
+        "entropy", "examples/audit_nondet.py", salt_line)
+    payload = json.loads(format_json(res))
+    assert payload["ok"] is False
+    assert payload["findings"][0]["line"] == salt_line
+
+
+def _load_audit_nondet():
+    path = os.path.join(_REPO, "examples", "audit_nondet.py")
+    spec = importlib.util.spec_from_file_location("_audit_nondet", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_same_bug_static_and_runtime(monkeypatch):
+    """The line the linter names is the line the audit blames: re-import
+    draws a fresh SALT (the process-restart path), and per-epoch ring
+    digests of the salted values diverge exactly as
+    ``recovery.audit.divergence`` reports it."""
+    from clonos_tpu.obs.digest import EpochDigest, diff_ledgers
+
+    monkeypatch.chdir(_REPO)
+    res = run_lint(["examples/audit_nondet.py"])
+    (finding,) = res.errors
+    assert finding.rule == "entropy"
+
+    salt_a = _load_audit_nondet().SALT
+    salt_b = salt_a
+    for _ in range(8):                # 2^-24 collision: retry, don't flake
+        salt_b = _load_audit_nondet().SALT
+        if salt_b != salt_a:
+            break
+    assert salt_a != salt_b
+
+    def ledger(salt):
+        d = EpochDigest(0)
+        for v in range(16):           # the example's salt-map transform
+            salted = (v * 31 + salt) % 9973
+            d.fold("ring/salt", salted.to_bytes(4, "little"))
+        return [d.to_entry()]
+
+    lines = diff_ledgers(ledger(salt_a), ledger(salt_b))
+    assert lines and "ring/salt" in lines[0]
+    assert "content divergence" in lines[0]
+
+
+# --- markers rule (absorbed check_markers) --------------------------------
+
+
+def test_markers_rule_flags_unregistered(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    tests_dir = tmp_path / "tests"
+    tests_dir.mkdir()
+    # Built by concatenation: a literal marker here would trip the
+    # session-configure lint on THIS file (see module docstring).
+    bad = "import pytest\n@pytest." + "mark.mystery\ndef test_x():\n    pass\n"
+    (tests_dir / "test_bad.py").write_text(bad)
+    res = run_lint(["tests"])
+    (f,) = _hits(res, "markers")
+    assert f.line == 2 and "mystery" in f.message
+    # The nondet families stay out of tests/ — no cross-talk.
+    assert {x.rule for x in res.findings} == {"markers"}
+
+
+def test_check_markers_shim(tmp_path):
+    import subprocess
+    import sys
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "check_markers.py")],
+        capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stderr
+    assert "markers ok" in proc.stdout
+
+
+# --- CLI ------------------------------------------------------------------
+
+
+def test_cli_lint_json_and_exit_codes(monkeypatch, capsys):
+    from clonos_tpu.cli import main
+
+    monkeypatch.chdir(_REPO)
+    assert main(["lint", "clonos_tpu", "examples"]) == 0
+    capsys.readouterr()
+    rc = main(["lint", "examples/audit_nondet.py", "--report", "json"])
+    assert rc == 1
+    payload = json.loads(capsys.readouterr().out.strip())
+    assert payload["ok"] is False and payload["errors"] == 1
+    (f,) = payload["findings"]
+    assert f["rule"] == "entropy"
+    assert f["path"] == "examples/audit_nondet.py"
+
+
+def test_cli_list_rules_and_bad_rule_filter(monkeypatch, capsys):
+    from clonos_tpu.cli import main
+
+    monkeypatch.chdir(_REPO)
+    assert main(["lint", "--list-rules"]) == 0
+    out = capsys.readouterr().out
+    assert "wallclock" in out and "lock-discipline" in out
+    assert main(["lint", "--rule", "bogus-rule", "clonos_tpu"]) == 2
+
+
+def test_collect_files_dedup_and_skip_dirs(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    (tmp_path / "a.py").write_text("X = 1\n")
+    (tmp_path / "__pycache__").mkdir()
+    (tmp_path / "__pycache__" / "junk.py").write_text("X = 1\n")
+    files = collect_files(["a.py", "."])
+    assert files == ["a.py"]

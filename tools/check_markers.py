@@ -1,64 +1,30 @@
 #!/usr/bin/env python
-"""Marker lint: every ``pytest.mark.<name>`` in tests/ must be either a
-pytest builtin or registered in REGISTERED_MARKERS (which
-tests/conftest.py registers with pytest at configure time, keeping this
-file the single source of truth). Unregistered markers are silent
-no-ops under ``-m`` filters — a test tagged with a typo'd ``slow``
-would run in tier-1 forever — so the lint runs inside pytest_configure
-and fails the session loudly.
-
-Standalone: ``python tools/check_markers.py`` exits 1 listing
-violations.
+"""Shim over ``clonos_tpu.lint.markers`` (the ``replay_dissect`` ->
+``dissect`` precedent): the marker registry and the scan both moved
+into the lint package as the ``markers`` rule, where
+``clonos_tpu lint tests/`` and tests/conftest.py share them. This file
+keeps the historical entry point — ``python tools/check_markers.py``
+still exits 1 listing violations — and the historical import surface
+(REGISTERED_MARKERS / BUILTIN_MARKERS / check).
 """
 
 import os
-import re
 import sys
 
-# Markers this repo registers (tier-1 deselects `slow`).
-REGISTERED_MARKERS = {
-    "slow": "long-running test, excluded from the tier-1 gate "
-            "(-m 'not slow')",
-}
-
-# Pytest's own markers — always legal, never need registration.
-BUILTIN_MARKERS = {
-    "parametrize", "skip", "skipif", "xfail", "usefixtures",
-    "filterwarnings",
-}
-
-_MARK_RE = re.compile(r"\bpytest\.mark\.([A-Za-z_]\w*)")
-
-
-def check(tests_dir):
-    """Scan ``tests_dir`` for marker uses; return a list of
-    '<file>:<line>: unregistered marker <name>' violations."""
-    allowed = BUILTIN_MARKERS | set(REGISTERED_MARKERS)
-    violations = []
-    for fn in sorted(os.listdir(tests_dir)):
-        if not fn.endswith(".py"):
-            continue
-        path = os.path.join(tests_dir, fn)
-        with open(path) as f:
-            for lineno, line in enumerate(f, 1):
-                for m in _MARK_RE.finditer(line):
-                    name = m.group(1)
-                    if name not in allowed:
-                        violations.append(
-                            f"{os.path.join('tests', fn)}:{lineno}: "
-                            f"unregistered marker {name!r}")
-    return violations
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+from clonos_tpu.lint.markers import (BUILTIN_MARKERS,     # noqa: E402,F401
+                                     REGISTERED_MARKERS, check)
 
 
 def main(argv=None):
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    tests_dir = os.path.join(root, "tests")
-    violations = check(tests_dir)
+    violations = check(os.path.join(root, "tests"))
     for v in violations:
         print(v, file=sys.stderr)
     if violations:
         print(f"{len(violations)} unregistered marker use(s); register "
-              f"in tools/check_markers.py:REGISTERED_MARKERS",
+              f"in clonos_tpu/lint/markers.py:REGISTERED_MARKERS",
               file=sys.stderr)
         return 1
     print(f"markers ok ({len(REGISTERED_MARKERS)} registered)")
