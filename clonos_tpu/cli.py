@@ -10,9 +10,12 @@ entrypoint under ``jax.distributed`` (see parallel/distributed.py).
 Usage:
     python -m clonos_tpu run <module:function> [--steps N] [--epochs N] ...
     python -m clonos_tpu info <module:function>
-    python -m clonos_tpu bench
+    python -m clonos_tpu bench [--jobs N]
     python -m clonos_tpu dryrun [--devices N]
-    python -m clonos_tpu audit <checkpoint-dir> [--diff DIR2] [--json]
+    python -m clonos_tpu dispatcher --lease DIR [--quota TENANT=N ...]
+    python -m clonos_tpu submit <module:function> --dispatcher HOST:PORT
+    python -m clonos_tpu jobs --dispatcher HOST:PORT
+    python -m clonos_tpu audit <checkpoint-dir> [--diff DIR2] [--job ID]
     python -m clonos_tpu dissect [--trials N]
 """
 
@@ -141,7 +144,7 @@ def cmd_info(args) -> int:
 
 def cmd_bench(args) -> int:
     import bench
-    bench.main()
+    bench.main(jobs=getattr(args, "jobs", None))
     return 0
 
 
@@ -246,10 +249,128 @@ def cmd_slotworker(args) -> int:
     return 0
 
 
+def cmd_dispatcher(args) -> int:
+    """Multi-tenant dispatcher entrypoint (runtime/dispatcher.py): one
+    shared slot pool serving many concurrent jobs. Slot workers point
+    their ``--jm`` at the printed jm address; clients submit over the
+    printed dispatcher address (``clonos_tpu submit`` / ``jobs``). One
+    JSON line with both addresses on startup."""
+    from clonos_tpu.runtime.dispatcher import Dispatcher
+
+    _setup_tracer(args, "dispatcher")
+    _setup_profile(args)
+    if args.audit:
+        from clonos_tpu.obs import configure_audit
+        configure_audit(on_divergence=args.audit)
+    quotas = {}
+    for spec in args.quota or []:
+        tenant, _, n = spec.partition("=")
+        quotas[tenant] = int(n)
+    disp = Dispatcher(
+        lease_path=args.lease, checkpoint_root=args.checkpoint_root,
+        quotas=quotas, default_quota=args.default_quota,
+        runner_kw={"steps_per_epoch": args.steps_per_epoch,
+                   "seed": args.seed},
+        target_epochs=args.epochs, complete_every=args.complete_every,
+        trace_dir=args.trace_dir, host=args.bind_host, port=args.port,
+        heartbeat_timeout_s=args.heartbeat_timeout)
+    endpoint = None
+    if args.metrics_port is not None:
+        from clonos_tpu.utils.metrics import (MetricRegistry,
+                                              MetricsEndpoint)
+        endpoint = MetricsEndpoint(
+            MetricRegistry(), port=args.metrics_port,
+            extra=disp.metrics_extra, history=_make_history(args))
+        print(f"# metrics: http://{endpoint.address[0]}:"
+              f"{endpoint.address[1]}/metrics", file=sys.stderr)
+    print(json.dumps({"dispatcher": list(disp.address),
+                      "jm": list(disp.jm.address)}), flush=True)
+    try:
+        disp.run(max_seconds=args.max_seconds)
+    finally:
+        disp.close()
+        if endpoint is not None:
+            endpoint.close()
+    return 0
+
+
+def cmd_submit(args) -> int:
+    """Submit a job to a running dispatcher. Prints the admission
+    result ({job_id, state}) or, with ``--wait``, the terminal job
+    record; a typed quota rejection prints its error JSON and exits
+    1."""
+    from clonos_tpu.parallel import transport as tp
+
+    host, _, port = args.dispatcher.partition(":")
+    client = tp.ControlClient((host, int(port)))
+    cfg = {"tenant": args.tenant, "slots": args.slots,
+           "max_concurrent_recoveries": args.max_recoveries}
+    if args.workers:
+        cfg["workers"] = [w for w in args.workers.split(",") if w]
+    req = {"job": args.job, "tenant_config": cfg}
+    if args.target_epochs is not None:
+        req["target_epochs"] = args.target_epochs
+    try:
+        rt, resp = client.call(tp.SUBMIT_JOB, tp.pack_json(req))
+        body = tp.unpack_json(resp)
+        if rt == tp.ERROR:
+            print(json.dumps(body))
+            return 1
+        if args.wait:
+            deadline = time.monotonic() + args.timeout
+            while time.monotonic() < deadline:
+                body = client.call_json(
+                    tp.JOB_STATUS, {"job_id": body["job_id"]})
+                if body["state"] in ("FINISHED", "FAILED", "CANCELLED"):
+                    break
+                time.sleep(0.5)
+    finally:
+        client.close()
+    print(json.dumps(body))
+    return 1 if body.get("state") == "FAILED" else 0
+
+
+def cmd_jobs(args) -> int:
+    """List a dispatcher's jobs (or cancel one with ``--cancel``)."""
+    from clonos_tpu.parallel import transport as tp
+
+    host, _, port = args.dispatcher.partition(":")
+    client = tp.ControlClient((host, int(port)))
+    try:
+        if args.cancel:
+            print(json.dumps(client.call_json(
+                tp.CANCEL_JOB, {"job_id": args.cancel})))
+            return 0
+        res = client.call_json(tp.JOB_STATUS, {})
+    finally:
+        client.close()
+    if args.json:
+        print(json.dumps(res))
+        return 0
+    jobs = res.get("jobs", [])
+    print(f"{'JOB':<20} {'TENANT':<12} {'STATE':<11} {'SLOTS':>5}  "
+          f"PLACEMENTS")
+    for j in jobs:
+        placements = " ".join(
+            f"g{g}={w}" for g, w in sorted(
+                (j.get("placements") or {}).items()))
+        if j.get("error"):
+            placements = (placements + "  " if placements else "") \
+                + f"error: {j['error']}"
+        print(f"{j['job_id']:<20} {j['tenant']:<12} {j['state']:<11} "
+              f"{j['slots']:>5}  {placements}")
+    if not jobs:
+        print("(no jobs submitted)")
+    return 0
+
+
 def _find_ledgers(root):
     """Ledger files under ``root``: the path itself (file or dir with
-    ledger.jsonl) or per-group ``g*/ledger.jsonl`` subdirs (slot-pool
-    layout). Returns [(label, entries)] sorted by label."""
+    ledger.jsonl), per-group ``g*/ledger.jsonl`` subdirs (slot-pool
+    layout), or per-job ``<job_id>/g*/ledger.jsonl`` trees (dispatcher
+    layout — every job's artifacts live under ``<root>/<job_id>/``).
+    Returns [(label, entries)] sorted by label; dispatcher-layout
+    labels carry the job-id prefix (``<job_id>/g0/ledger.jsonl``)."""
     import glob
     import os
     from clonos_tpu.runtime.checkpoint import read_ledger_file
@@ -260,17 +381,36 @@ def _find_ledgers(root):
     if os.path.exists(direct):
         return [("ledger.jsonl", read_ledger_file(direct))]
     out = []
-    for p in sorted(glob.glob(os.path.join(root, "*", "ledger.jsonl"))):
-        out.append((os.path.join(os.path.basename(os.path.dirname(p)),
-                                 "ledger.jsonl"), read_ledger_file(p)))
-    return out
+    for pat in (os.path.join(root, "*", "ledger.jsonl"),
+                os.path.join(root, "*", "*", "ledger.jsonl")):
+        for p in sorted(glob.glob(pat)):
+            label = os.path.relpath(p, root)
+            out.append((label, read_ledger_file(p)))
+    return sorted(out)
+
+
+def _ledger_job_ids(ledgers):
+    """Job ids present in a dispatcher-layout ledger set: the leading
+    path component of every ``<job_id>/g*/ledger.jsonl`` label."""
+    import os
+    jobs = set()
+    for label, _ in ledgers:
+        parts = label.split(os.sep)
+        if len(parts) >= 3:
+            jobs.add(parts[0])
+    return sorted(jobs)
 
 
 def cmd_audit(args) -> int:
     """Print or diff a job's epoch audit ledger (``clonos_tpu audit``):
     the per-epoch digests obs/audit.py sealed at each checkpoint
     barrier. ``--diff`` compares against a second run's ledger and
-    exits 1 on the first divergence (epoch + channel named)."""
+    exits 1 on the first divergence (epoch + channel named). A
+    dispatcher root holds MANY jobs' ledgers (``<root>/<job_id>/g*/``);
+    ``--job`` selects one (labels lose the job prefix so they line up
+    against a single-job run's), and a diff over an ambiguous
+    multi-job root exits 2 listing the available job ids."""
+    import os
     from clonos_tpu.obs import digest as _digest
 
     ledgers = _find_ledgers(args.dir)
@@ -282,8 +422,35 @@ def cmd_audit(args) -> int:
         else:
             print(f"no ledger.jsonl under {args.dir}", file=sys.stderr)
         return 1
+    job_ids = _ledger_job_ids(ledgers)
+    job = getattr(args, "job", None)
+    if job:
+        pre = job + os.sep
+        picked = [(label[len(pre):], entries)
+                  for label, entries in ledgers
+                  if label.startswith(pre)]
+        if not picked:
+            print(f"no ledgers for job {job!r} under {args.dir} "
+                  f"(available job ids: "
+                  f"{', '.join(job_ids) or 'none'})", file=sys.stderr)
+            return 2
+        ledgers = picked
+    elif args.diff and len(job_ids) > 1:
+        print(f"ambiguous: {args.dir} holds ledgers for "
+              f"{len(job_ids)} jobs ({', '.join(job_ids)}) — pass "
+              f"--job <id> to pick one", file=sys.stderr)
+        return 2
     if args.diff:
-        other = dict(_find_ledgers(args.diff))
+        other_ledgers = _find_ledgers(args.diff)
+        if job:
+            pre = job + os.sep
+            picked = [(label[len(pre):], entries)
+                      for label, entries in other_ledgers
+                      if label.startswith(pre)]
+            # The compared run may itself be single-job (no prefix);
+            # fall through to its raw labels then.
+            other_ledgers = picked or other_ledgers
+        other = dict(other_ledgers)
         problems = []
         groups = {}
         for label, entries in ledgers:
@@ -358,6 +525,11 @@ def _top_rows(snap):
             continue
         if rest.startswith("group."):
             r["groups"].add(rest.split(".", 2)[1])
+        elif rest.startswith("job."):
+            # multi-tenant prefix: job.<jid>.group.<g>.<metric>
+            jparts = rest.split(".")
+            if len(jparts) >= 4 and jparts[2] == "group":
+                r["groups"].add(f"{jparts[1]}:g{jparts[3]}")
         num = isinstance(v, (int, float)) and not isinstance(v, bool)
         if num and rest.endswith(".audit.epochs-sealed"):
             r["sealed"] += int(v)
@@ -396,8 +568,43 @@ def _top_table(snap) -> str:
                      f"{lag:>5} {ft:>7}  {phases}")
     if not rows:
         lines.append("(no worker.* metrics yet)")
+    # Per-job section (multi-tenant dispatcher): one row per job id
+    # from the cluster.job.<jid>.* rollups remote.cluster_metrics()
+    # computes, plus the dispatcher's tenant admission gauges.
+    jobs = {}
+    for k, v in snap.items():
+        if k.startswith("cluster.job."):
+            jid, _, metric = k[len("cluster.job."):].partition(".")
+            if jid and metric:
+                jobs.setdefault(jid, {})[metric] = v
+
+    def _cell(m, name):
+        v = m.get(name)
+        return "-" if v is None else str(v)
+
+    if jobs:
+        lines.append("")
+        lines.append(f"{'JOB':<20} {'GROUPS':>6} {'SEALED':>6} "
+                     f"{'VALID':>5} {'DIV':>4} {'XONCE':>5}")
+        for jid in sorted(jobs):
+            m = jobs[jid]
+            lines.append(
+                f"{jid:<20} {_cell(m, 'groups'):>6} "
+                f"{_cell(m, 'audit.epochs-sealed'):>6} "
+                f"{_cell(m, 'audit.epochs-validated'):>5} "
+                f"{_cell(m, 'audit.divergences'):>4} "
+                f"{_cell(m, 'audit.exactly-once-ok'):>5}")
+    tenant = {k: v for k, v in sorted(snap.items())
+              if (k.startswith("tenant.")
+                  or k.startswith("dispatcher."))
+              and isinstance(v, (int, float))}
+    if tenant:
+        lines.append("")
+        lines.append("tenants: " + "  ".join(
+            f"{k}={v}" for k, v in tenant.items()))
     cluster = {k: v for k, v in sorted(snap.items())
                if k.startswith("cluster.")
+               and not k.startswith("cluster.job.")
                and isinstance(v, (int, float))}
     if cluster:
         lines.append("")
@@ -629,6 +836,10 @@ def main(argv=None) -> int:
     pi.set_defaults(fn=cmd_info)
 
     pb = sub.add_parser("bench", help="run the headline benchmark")
+    pb.add_argument("--jobs", type=int, default=None, metavar="N",
+                    help="run ONLY the multi-job throughput probe with "
+                         "N concurrent in-process jobs (per-tenant "
+                         "steady-state records/sec + fairness ratio)")
     pb.set_defaults(fn=cmd_bench)
 
     pd = sub.add_parser("dryrun", help="multichip sharding dry run")
@@ -698,6 +909,84 @@ def main(argv=None) -> int:
     _add_profile_args(ps)
     ps.set_defaults(fn=cmd_slotworker)
 
+    pc = sub.add_parser("dispatcher",
+                        help="multi-tenant dispatcher: one shared slot "
+                             "pool serving many concurrent jobs")
+    pc.add_argument("--lease", required=True,
+                    help="cluster lease path; each job's leader claims "
+                         "<lease>.<job-id>.epochN.claim (slot workers "
+                         "validate DEPLOY fencing against the same "
+                         "path)")
+    pc.add_argument("--checkpoint-root",
+                    default="/tmp/clonos-dispatcher",
+                    help="every job's checkpoints + ledgers land under "
+                         "<root>/<job-id>/")
+    pc.add_argument("--quota", action="append", default=[],
+                    metavar="TENANT=N",
+                    help="per-tenant slot quota (repeatable); "
+                         "submissions beyond it are rejected with a "
+                         "typed quota-exceeded error")
+    pc.add_argument("--default-quota", type=int, default=None,
+                    help="slot quota for tenants without an explicit "
+                         "--quota (default: unlimited)")
+    pc.add_argument("--port", type=int, default=0,
+                    help="dispatcher submit/status port (0 = ephemeral)")
+    pc.add_argument("--bind-host", default="127.0.0.1")
+    pc.add_argument("--epochs", type=int, default=8,
+                    help="default target epochs per job (submit may "
+                         "override)")
+    pc.add_argument("--steps-per-epoch", type=int, default=16)
+    pc.add_argument("--seed", type=int, default=0)
+    pc.add_argument("--complete-every", type=int, default=1)
+    pc.add_argument("--heartbeat-timeout", type=float, default=5.0)
+    pc.add_argument("--max-seconds", type=float, default=600.0,
+                    help="wall guard: exit after this long")
+    pc.add_argument("--audit", choices=["warn", "abort"], default=None,
+                    help="enable the exactly-once audit for every "
+                         "deployed job (DEPLOY headers carry the "
+                         "stance)")
+    pc.add_argument("--metrics-port", type=int, default=None,
+                    help="serve /metrics + /metrics.json with "
+                         "per-tenant rollups (0 = ephemeral)")
+    pc.add_argument("--trace-dir", default=None,
+                    help="per-job trace files "
+                         "(trace-jm.<job-id>.jsonl) land here")
+    _add_profile_args(pc)
+    pc.set_defaults(fn=cmd_dispatcher)
+
+    pj = sub.add_parser("submit", help="submit a job to a running "
+                                       "dispatcher")
+    pj.add_argument("job", help="module:function returning a JobGraph "
+                                "(resolved by the slot workers)")
+    pj.add_argument("--dispatcher", required=True,
+                    help="dispatcher host:port")
+    pj.add_argument("--tenant", default="default")
+    pj.add_argument("--slots", type=int, default=1,
+                    help="slices to cut the job into (= pool slots "
+                         "held)")
+    pj.add_argument("--max-recoveries", type=int, default=1,
+                    help="cap on concurrently rebuilt groups after a "
+                         "worker death (storm containment)")
+    pj.add_argument("--workers", default=None,
+                    help="comma-separated placement hint (slice i "
+                         "prefers the i-th worker)")
+    pj.add_argument("--target-epochs", type=int, default=None)
+    pj.add_argument("--wait", action="store_true",
+                    help="poll until the job reaches a terminal state")
+    pj.add_argument("--timeout", type=float, default=600.0,
+                    help="--wait deadline (seconds)")
+    pj.set_defaults(fn=cmd_submit)
+
+    po = sub.add_parser("jobs", help="list (or cancel) a dispatcher's "
+                                     "jobs")
+    po.add_argument("--dispatcher", required=True,
+                    help="dispatcher host:port")
+    po.add_argument("--json", action="store_true",
+                    help="machine-readable job list")
+    po.add_argument("--cancel", default=None, metavar="JOB_ID",
+                    help="cancel this job instead of listing")
+    po.set_defaults(fn=cmd_jobs)
+
     pt = sub.add_parser("trace", help="summarize or convert recorded "
                                       "trace JSON-lines files")
     pt.add_argument("files", nargs="+",
@@ -722,6 +1011,11 @@ def main(argv=None) -> int:
                     help="second run's checkpoint dir; exit 1 naming "
                          "the first diverging epoch and channel per "
                          "group")
+    pa.add_argument("--job", default=None, metavar="ID",
+                    help="select one job's ledgers under a dispatcher "
+                         "root (<dir>/<job-id>/g*/ledger.jsonl); "
+                         "without it a --diff over a multi-job root "
+                         "exits 2 listing the available job ids")
     pa.add_argument("--json", action="store_true",
                     help="dump raw ledger entries as JSON")
     pa.add_argument("--report", choices=["json"], default=None,

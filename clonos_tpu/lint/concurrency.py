@@ -1,9 +1,9 @@
 """Rule family 3: lock discipline in the threaded runtime.
 
-runtime/cluster.py, runtime/checkpoint.py and obs/history.py document
-shared attributes as lock-guarded (``_writer_lock``, ``_lock``,
-``_rjit_lock``): every mutation of the guarded state is supposed to
-happen inside ``with self.<lock>:``. The guard set is inferred rather
+runtime/cluster.py, runtime/checkpoint.py, runtime/dispatcher.py and
+obs/history.py document shared attributes as lock-guarded
+(``_writer_lock``, ``_lock``, ``_rjit_lock``): every mutation of the
+guarded state is supposed to happen inside ``with self.<lock>:``. The guard set is inferred rather
 than declared: an attribute counts as guarded once any method mutates
 it under the lock. A mutation of a guarded attribute on a path that
 provably never holds the lock is then a finding — exactly the
@@ -116,6 +116,14 @@ class _MethodScan:
                 a = _self_attr(t)
                 if a is not None:
                     attr = a
+        elif isinstance(node, ast.Delete):
+            # `del self._jobs[jid]` mutates the container just like a
+            # store does — the dispatcher's job table shrinks this way.
+            for t in node.targets:
+                a = _self_attr(t)
+                if a is not None:
+                    attr = a
+                    verb = "deletes from"
         elif isinstance(node, ast.Call) \
                 and isinstance(node.func, ast.Attribute):
             if node.func.attr in MUTATING_METHODS:

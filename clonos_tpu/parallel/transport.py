@@ -50,6 +50,14 @@ DEPLOY = 17                # JobMaster -> TaskExecutor: fenced task slice
 TASK_STATE = 18            # TaskExecutor -> JobMaster: task transition
 FETCH_EDGE = 19            # downstream worker -> upstream edge export
 EDGE_DATA = 20             # payload = JSON header | int32 record rows
+# dispatcher surface (runtime/dispatcher.py; reference
+# Dispatcher.submitJob -> per-job JobMaster over one TaskManager pool).
+# DEPLOY / TASK_STATE / FETCH_EDGE headers carry a ``job_id`` field in
+# multi-job deployments so one worker routes per-job state; absent
+# job_id means the legacy single-job cluster (wire bytes unchanged).
+SUBMIT_JOB = 21            # client -> Dispatcher: JobGraph + tenant config
+JOB_STATUS = 22            # client -> Dispatcher: one job / list all jobs
+CANCEL_JOB = 23            # client -> Dispatcher: cancel / abandon a job
 
 
 def _send(sock: socket.socket, mtype: int, payload: bytes) -> None:

@@ -35,6 +35,26 @@ import time
 from typing import List, Optional, Tuple
 
 
+def job_lease_path(base: str, job_id: Optional[str]) -> str:
+    """Job-scoped lease namespace under one cluster lease ``base``.
+
+    A multi-job cluster (runtime/dispatcher.py) runs one election PER
+    JOB: each job's JobMaster fences its own DEPLOYs with its own epoch
+    sequence. Claim files are discovered by basename prefix
+    (``<path>.epoch<N>.claim``), so two jobs sharing one ``base`` would
+    read each other's claims and a leader change in job A would fence
+    job B's deployments. Scoping the path —
+    ``<base>.<job_id>.epoch<N>.claim`` — keeps every job's claim family
+    disjoint while still living in the shared lease directory workers
+    validate against. An empty job id is the legacy single-job cluster:
+    the base path is used as-is (claim files byte-identical)."""
+    if not job_id:
+        return base
+    if "/" in job_id:
+        raise ValueError(f"job id {job_id!r} must not contain '/'")
+    return f"{base}.{job_id}"
+
+
 class FileLeaderElection:
     """One contender's handle on a claim-file election."""
 

@@ -60,7 +60,9 @@ class JobMasterServer:
         self._meta: Dict[str, dict] = {}
         self._ignored: List[int] = []
         self._slots: Dict[str, int] = {}
-        self._tasks: Dict[Tuple[str, int], dict] = {}
+        #: (executor_id, job_id, group) -> last TASK_STATE report;
+        #: job_id "" is the legacy single-job cluster
+        self._tasks: Dict[Tuple[str, str, int], dict] = {}
         #: executor_id -> last metric snapshot piggybacked on HEARTBEAT
         self._hb_metrics: Dict[str, dict] = {}
         self._lock = threading.Lock()
@@ -97,8 +99,10 @@ class JobMasterServer:
             return tp.OK, tp.pack_json({"slots": self._slots[eid]})
         if mtype == tp.TASK_STATE:
             info = tp.unpack_json(payload)
+            key = (info["executor_id"], str(info.get("job_id") or ""),
+                   int(info["group"]))
             with self._lock:
-                self._tasks[(info["executor_id"], int(info["group"]))] = info
+                self._tasks[key] = info
             return tp.OK, b""
         return tp.ERROR, tp.pack_json({"error": f"bad mtype {mtype}"})
 
@@ -119,10 +123,13 @@ class JobMasterServer:
                 raise KeyError(f"executor {executor_id!r} never registered")
             return dict(self._meta[executor_id])
 
-    def task_state(self, executor_id: str, group: int) -> Optional[dict]:
-        """Latest TASK_STATE report for ``(executor_id, group)``."""
+    def task_state(self, executor_id: str, group: int,
+                   job_id: str = "") -> Optional[dict]:
+        """Latest TASK_STATE report for ``(executor_id, job_id, group)``
+        (empty job_id = the legacy single-job cluster)."""
         with self._lock:
-            return self._tasks.get((executor_id, group))
+            return self._tasks.get((executor_id, str(job_id or ""),
+                                    int(group)))
 
     def cluster_metrics(self) -> Dict[str, object]:
         """Cluster-wide metric view: every worker's last heartbeat
@@ -166,6 +173,44 @@ class JobMasterServer:
                 max(fracs), 6)
             out["cluster.overhead.ft-fraction-mean"] = round(
                 sum(fracs) / len(fracs), 6)
+        # Per-job rollups (multi-tenant pool): slice workers prefix a
+        # job-scoped slice's metrics ``job.<jid>.group.<g>.`` — roll
+        # each job's slice count and audit chain up under
+        # ``cluster.job.<jid>.*`` so /metrics.json and `clonos_tpu top`
+        # read exactly-once health PER TENANT. Single-job clusters emit
+        # no job-prefixed keys and get no extra rows.
+        jobs: Dict[str, dict] = {}
+        for k, v in out.items():
+            parts = k.split(".")
+            if (len(parts) < 6 or parts[0] != "worker"
+                    or parts[2] != "job" or parts[4] != "group"):
+                continue
+            rec = jobs.setdefault(parts[3], {
+                "groups": set(), "sealed": 0, "validated": 0,
+                "div": 0, "audited": False})
+            rec["groups"].add(parts[5])
+            if not isinstance(v, (int, float)) or isinstance(v, bool):
+                continue
+            if k.endswith("audit.epochs-sealed"):
+                rec["sealed"] += v
+                rec["audited"] = True
+            elif k.endswith("audit.epochs-validated"):
+                rec["validated"] += v
+                rec["audited"] = True
+            elif k.endswith("audit.divergences"):
+                rec["div"] += v
+                rec["audited"] = True
+        for jid, rec in sorted(jobs.items()):
+            out[f"cluster.job.{jid}.groups"] = len(rec["groups"])
+            if rec["audited"]:
+                out[f"cluster.job.{jid}.audit.epochs-sealed"] = \
+                    int(rec["sealed"])
+                out[f"cluster.job.{jid}.audit.epochs-validated"] = \
+                    int(rec["validated"])
+                out[f"cluster.job.{jid}.audit.divergences"] = \
+                    int(rec["div"])
+                out[f"cluster.job.{jid}.audit.exactly-once-ok"] = \
+                    int(rec["div"] == 0)
         return out
 
     def expired(self) -> List[str]:

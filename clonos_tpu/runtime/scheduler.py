@@ -61,13 +61,14 @@ deadlocking forever).
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import importlib
 import json
 import queue
 import threading
 import time
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Deque, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -77,7 +78,7 @@ from clonos_tpu.obs import get_tracer
 from clonos_tpu.parallel import transport as tp
 from clonos_tpu.parallel.distributed import standby_worker_order
 from clonos_tpu.runtime import remote as rm
-from clonos_tpu.runtime.leader import FileLeaderElection
+from clonos_tpu.runtime.leader import FileLeaderElection, job_lease_path
 
 
 class NotLeaderError(RuntimeError):
@@ -148,18 +149,24 @@ def cut_edges(job: JobGraph, part: Sequence[int]
 
 @dataclasses.dataclass
 class TaskSlot:
-    """One deployment slot on a worker (SlotPool's allocation unit)."""
+    """One deployment slot on a worker (SlotPool's allocation unit).
+
+    ``group`` is the occupying task-group key: a bare int in legacy
+    single-job mode, a ``(job_id, group)`` tuple when many jobs share
+    the pool (runtime/dispatcher.py) — the pool only needs it hashable
+    and orderable within one deployment."""
 
     worker_id: str
     index: int
-    group: Optional[int] = None        # occupying task group, or free
+    group: Optional[object] = None     # occupying task group, or free
 
 
 class SlotPool:
     """JobMaster-side ledger of advertised slots and their occupants
     (reference SlotPool.java: offers come in from TaskExecutors, the
     scheduler allocates against them, a dead worker releases its slots
-    and strands its groups for redeployment)."""
+    and strands its groups for redeployment). One pool may be shared by
+    many jobs' schedulers — group keys are then job-scoped tuples."""
 
     def __init__(self):
         self._slots: Dict[str, List[TaskSlot]] = {}
@@ -180,7 +187,7 @@ class SlotPool:
         return [s for w in self.workers() if w not in set(avoid)
                 for s in self._slots[w] if s.group is None]
 
-    def allocate(self, group: int, prefer: Optional[str] = None,
+    def allocate(self, group, prefer: Optional[str] = None,
                  avoid: Sequence[str] = ()) -> TaskSlot:
         free = self.free_slots(avoid)
         if prefer is not None:
@@ -194,19 +201,19 @@ class SlotPool:
         slot.group = group
         return slot
 
-    def release_group(self, group: int) -> None:
+    def release_group(self, group) -> None:
         for ss in self._slots.values():
             for s in ss:
                 if s.group == group:
                     s.group = None
 
-    def drop_worker(self, worker_id: str) -> List[int]:
+    def drop_worker(self, worker_id: str) -> List[object]:
         """Worker died: forget its slots; returns the task groups that
         were running there (the redeployment work list)."""
         lost = self._slots.pop(worker_id, [])
         return sorted(s.group for s in lost if s.group is not None)
 
-    def placements(self) -> Dict[int, str]:
+    def placements(self) -> Dict[object, str]:
         return {s.group: w for w, ss in self._slots.items()
                 for s in ss if s.group is not None}
 
@@ -443,28 +450,34 @@ class TaskExecutorEndpoint:
                  host: str = "127.0.0.1", port: int = 0):
         self.queue: "queue.Queue[dict]" = queue.Queue()
         self._lease_path = lease_path
-        self._highest = -1
+        # Highest accepted token PER JOB: every job runs its own
+        # election (leader.job_lease_path), so epoch sequences are
+        # independent — job A's epoch 5 must not fence job B's epoch 1.
+        # "" is the legacy single-job cluster.
+        self._highest: Dict[str, int] = {}
         self._lock = threading.Lock()
         self.server = tp.ControlServer(self._handle, host, port)
         self.address = self.server.address
 
-    def _check_fencing(self, epoch) -> None:
+    def _check_fencing(self, epoch, job_id: str = "") -> None:
         if epoch is None:
             raise PermissionError("DEPLOY carries no fencing token")
         epoch = int(epoch)
         with self._lock:
-            if epoch < self._highest:
+            if epoch < self._highest.get(job_id, -1):
                 raise PermissionError(
                     f"stale fencing token {epoch} < highest accepted "
-                    f"{self._highest} (deposed JobMaster)")
+                    f"{self._highest[job_id]} (deposed JobMaster)")
         if self._lease_path is not None:
-            observer = FileLeaderElection(self._lease_path, "observer")
+            observer = FileLeaderElection(
+                job_lease_path(self._lease_path, job_id), "observer")
             if not observer.fencing_valid(epoch):
                 raise PermissionError(
                     f"fencing token {epoch} is not the current lease "
                     f"claim — deposed or forged JobMaster identity")
         with self._lock:
-            self._highest = max(self._highest, epoch)
+            self._highest[job_id] = max(self._highest.get(job_id, -1),
+                                        epoch)
 
     def _handle(self, mtype: int, payload: bytes) -> Tuple[int, bytes]:
         if mtype != tp.DEPLOY:
@@ -472,7 +485,8 @@ class TaskExecutorEndpoint:
         hlen = int.from_bytes(payload[:4], "little")
         tdd = tp.unpack_json(payload[4: 4 + hlen])
         try:
-            self._check_fencing(tdd.get("fencing_epoch"))
+            self._check_fencing(tdd.get("fencing_epoch"),
+                                str(tdd.get("job_id") or ""))
         except PermissionError as e:
             return tp.ERROR, tp.pack_json({"error": str(e)})
         frame = payload[4 + hlen:]
@@ -499,6 +513,11 @@ class _DeployedSlice:
     complete_every: int
     attempt: int
     finished: bool = False
+    job_id: str = ""                   # "" = legacy single-job cluster
+    #: the deploying JobMaster's trace context — re-adopted before every
+    #: epoch so co-hosted slices of DIFFERENT jobs each span under their
+    #: own job's trace id
+    trace_ctx: Optional[dict] = None
 
 
 class SliceWorker:
@@ -531,7 +550,12 @@ class SliceWorker:
             info={"slots": slots, "deploy_host": bind_host,
                   "deploy_port": self.endpoint.address[1]},
             payload_fn=self._hb_payload)
-        self.slices: Dict[int, _DeployedSlice] = {}
+        #: deployed slices keyed (job_id, group) — one worker may host
+        #: slices of many concurrent jobs (the multi-tenant pool)
+        self.slices: Dict[Tuple[str, int], _DeployedSlice] = {}
+        #: recovery rebuilds deferred behind healthy epochs (fence
+        #: priority — see :meth:`step`)
+        self._recovery_backlog: Deque[dict] = collections.deque()
         self._emit = emit or (lambda obj: print(json.dumps(obj),
                                                 flush=True))
 
@@ -542,19 +566,26 @@ class SliceWorker:
 
     def _refresh_metrics(self) -> None:
         """Main-thread snapshot of every slice's registry (replaces the
-        cache wholesale; the heartbeat thread only reads the old ref)."""
+        cache wholesale; the heartbeat thread only reads the old ref).
+        Job-scoped slices prefix ``job.<jid>.`` so the JobMaster can
+        roll metrics up per tenant (remote.cluster_metrics)."""
         snap: Dict[str, object] = {}
-        for group, sl in self.slices.items():
+        for (jid, group), sl in self.slices.items():
+            prefix = (f"job.{jid}.group.{group}." if jid
+                      else f"group.{group}.")
             for k, v in sl.runner.metrics.snapshot().items():
-                snap[f"group.{group}.{k}"] = v
+                snap[prefix + k] = v
         with self._metrics_lock:
             self._metrics_cache = snap
 
-    def _task_state(self, group: int, state: str, **extra) -> None:
+    def _task_state(self, group: int, state: str, job_id: str = "",
+                    **extra) -> None:
+        msg = {"executor_id": self.executor_id, "group": group,
+               "state": state, **extra}
+        if job_id:
+            msg["job_id"] = job_id
         try:
-            self._jm.call_json(tp.TASK_STATE, {
-                "executor_id": self.executor_id, "group": group,
-                "state": state, **extra})
+            self._jm.call_json(tp.TASK_STATE, msg)
         except (OSError, RuntimeError):
             pass        # JM unreachable; its heartbeat deadline arbitrates
 
@@ -578,17 +609,21 @@ class SliceWorker:
         the descriptor ships mirror rows)."""
         from clonos_tpu.runtime.cluster import ClusterRunner
         group = int(tdd["group"])
+        jid = str(tdd.get("job_id") or "")
         attempt = int(tdd.get("attempt", 0))
-        # Join the JobMaster's trace: every span this worker emits from
-        # here on (epochs, checkpoints, recovery phases) shares its id.
-        # Likewise its audit stance (a JobMaster with auditing on makes
-        # every deployed runner seal + validate epoch digests) and its
-        # profiling stance (overhead attribution spans the slot pool).
+        # Join the deploying JobMaster's trace: every span this worker
+        # emits for THIS slice (epochs, checkpoints, recovery phases)
+        # shares its id — per job, since each job's JobMaster runs its
+        # own tracer (the context is kept on the slice and re-adopted
+        # before every epoch). Likewise its audit stance (a JobMaster
+        # with auditing on makes every deployed runner seal + validate
+        # epoch digests) and its profiling stance (overhead attribution
+        # spans the slot pool).
         tp.adopt_trace(tdd)
         tp.adopt_audit(tdd)
         tp.adopt_profile(tdd)
         tr = get_tracer()
-        self._task_state(group, "DEPLOYING", attempt=attempt)
+        self._task_state(group, "DEPLOYING", job_id=jid, attempt=attempt)
         job = _load_job(tdd["job"])
         sub, vmap, feeds, exports = job.subgraph(
             [int(v) for v in tdd["vertices"]],
@@ -600,9 +635,10 @@ class SliceWorker:
             readers[vmap[int(vid_s)]] = self._make_reader(spec)
         kw = dict(tdd.get("runner_kw") or {})
         recovered = bool(tdd.get("recover"))
+        span_kw = {"job": jid} if jid else {}
         if recovered:
             with tr.span("recovery.rebuild", group=group,
-                         attempt=attempt):
+                         attempt=attempt, **span_kw):
                 runner, _report = ClusterRunner.bootstrap_standby(
                     sub, tdd["checkpoint_dir"],
                     tdd.get("_mirror_rows") or {},
@@ -630,60 +666,91 @@ class SliceWorker:
             readers=readers,
             target_epochs=int(tdd.get("target_epochs", 8)),
             complete_every=int(tdd.get("complete_every", 1)),
-            attempt=attempt)
-        self.slices[group] = sl
+            attempt=attempt, job_id=jid, trace_ctx=tdd.get("trace"))
+        self.slices[(jid, group)] = sl
         if recovered:
             tr.event("recovery.caught_up", group=group, attempt=attempt,
                      epoch=runner.executor.epoch_id,
-                     global_step=runner.global_step)
+                     global_step=runner.global_step, **span_kw)
         self._task_state(
-            group, "RUNNING", attempt=attempt,
+            group, "RUNNING", job_id=jid, attempt=attempt,
             log_port=log_ep.address[1],
             export_ports={str(e): export.address[1] for e in exports}
             if export else {},
             num_subtasks=sub.total_subtasks(), recovered=recovered)
-        self._emit({"deployed": group, "attempt": attempt,
-                    "vertices": [int(v) for v in tdd["vertices"]],
-                    "recovered": recovered,
-                    "epoch": runner.executor.epoch_id,
-                    "global_step": runner.global_step,
-                    "digest": runner.state_digest()})
+        status = {"deployed": group, "attempt": attempt,
+                  "vertices": [int(v) for v in tdd["vertices"]],
+                  "recovered": recovered,
+                  "epoch": runner.executor.epoch_id,
+                  "global_step": runner.global_step,
+                  "digest": runner.state_digest()}
+        if jid:
+            status["job"] = jid
+        self._emit(status)
         return sl
 
     def step(self) -> bool:
-        """Drain pending deployments, then run one epoch of every due
-        slice. Returns whether anything progressed."""
+        """Drain pending deployments, run one epoch of every due slice,
+        then build AT MOST ONE recovery rebuild. Returns whether
+        anything progressed.
+
+        Ordering is the worker-side tenant-isolation mechanism: fresh
+        deployments build immediately, but recovery rebuilds (causal
+        replay — the expensive part of another tenant's failure storm)
+        are deferred to a backlog and admitted one per round, AFTER
+        every healthy slice has run its epoch. Between any two rebuilds
+        every co-hosted healthy tenant therefore reaches its next
+        checkpoint fence — a storm of N rebuilds inflates a neighbor's
+        fence latency by at most one rebuild each round, never by the
+        whole storm."""
         progressed = False
         while True:
             try:
                 tdd = self.endpoint.queue.get_nowait()
             except queue.Empty:
                 break
-            self.build(tdd)
+            if tdd.get("recover"):
+                self._recovery_backlog.append(tdd)
+            else:
+                self.build(tdd)
             progressed = True
-        for group in sorted(self.slices):
-            sl = self.slices[group]
+        tr = get_tracer()
+        for key in sorted(self.slices):
+            sl = self.slices[key]
+            group = sl.group
+            if tr.enabled and sl.trace_ctx:
+                # Each slice's spans land under its OWN job's trace id.
+                tr.adopt(sl.trace_ctx)
             if sl.runner.executor.epoch_id >= sl.target_epochs:
                 if not sl.finished:
                     sl.finished = True
                     if sl.export is not None:
                         sl.export.mark_final()
-                    self._task_state(group, "FINISHED",
+                    self._task_state(group, "FINISHED", job_id=sl.job_id,
                                      attempt=sl.attempt)
-                    self._emit({"finished": group,
-                                "epoch": sl.runner.executor.epoch_id,
-                                "global_step": sl.runner.global_step,
-                                "digest": sl.runner.state_digest()})
+                    status = {"finished": group,
+                              "epoch": sl.runner.executor.epoch_id,
+                              "global_step": sl.runner.global_step,
+                              "digest": sl.runner.state_digest()}
+                    if sl.job_id:
+                        status["job"] = sl.job_id
+                    self._emit(status)
                 continue
             closed = sl.runner.executor.epoch_id
             sl.runner.run_epoch(
                 complete_checkpoint=(closed % sl.complete_every == 0))
             # Status BEFORE the refresh (see class docstring).
-            self._emit({"group": group,
-                        "epoch": sl.runner.executor.epoch_id,
-                        "global_step": sl.runner.global_step,
-                        "digest": sl.runner.state_digest()})
+            status = {"group": group,
+                      "epoch": sl.runner.executor.epoch_id,
+                      "global_step": sl.runner.global_step,
+                      "digest": sl.runner.state_digest()}
+            if sl.job_id:
+                status["job"] = sl.job_id
+            self._emit(status)
             sl.log_ep.refresh()
+            progressed = True
+        if self._recovery_backlog:
+            self.build(self._recovery_backlog.popleft())
             progressed = True
         if progressed:
             self._refresh_metrics()
@@ -732,7 +799,9 @@ class SlotPoolScheduler:
                  checkpoint_root: str = "/tmp/clonos-scheduler",
                  mirror_capacity: int = 1 << 14,
                  mirror_max_epochs: int = 64,
-                 deploy_timeout_s: float = 240.0):
+                 deploy_timeout_s: float = 240.0,
+                 job_id: str = "", tenant: str = "",
+                 pool: Optional[SlotPool] = None, tracer=None):
         self.jm = jm
         self.election = election
         self.job_spec = job_spec
@@ -745,7 +814,19 @@ class SlotPoolScheduler:
         self.mirror_capacity = mirror_capacity
         self.mirror_max_epochs = mirror_max_epochs
         self.deploy_timeout_s = deploy_timeout_s
-        self.pool = SlotPool()
+        #: multi-tenant identity (runtime/dispatcher.py): a non-empty
+        #: job_id namespaces slot keys, DEPLOY headers, task_state
+        #: lookups, and standby bookkeeping so many schedulers share one
+        #: pool. "" is the legacy one-job-per-cluster mode.
+        self.job_id = str(job_id)
+        self.tenant = str(tenant)
+        #: a dispatcher passes its SHARED pool; a standalone scheduler
+        #: owns a private one and syncs offers itself on deploy()
+        self.pool = SlotPool() if pool is None else pool
+        self._owns_pool = pool is None
+        #: per-job tracer injected by the dispatcher (each job's spans
+        #: carry that job's trace id); None = the process tracer
+        self._tracer = tracer
         self.parts: List[List[int]] = []
         self.placements: Dict[int, str] = {}
         self.standby: Dict[int, str] = {}
@@ -763,6 +844,14 @@ class SlotPoolScheduler:
         self._m_fetch_ms = g.histogram("recovery.determinant-fetch-ms")
         self._m_redeploy_ms = g.histogram("recovery.redeploy-ms")
         self._detected: set = set()    # workers already traced as failed
+
+    def _tr(self):
+        return self._tracer if self._tracer is not None else get_tracer()
+
+    def _slot_key(self, group: int):
+        """Pool allocation key: job-scoped when this scheduler shares a
+        pool with other jobs, the bare group in legacy mode."""
+        return (self.job_id, int(group)) if self.job_id else int(group)
 
     # --- leadership ----------------------------------------------------------
 
@@ -803,7 +892,7 @@ class SlotPoolScheduler:
                       attempt: int) -> dict:
         deadline = time.monotonic() + self.deploy_timeout_s
         while time.monotonic() < deadline:
-            st = self.jm.task_state(worker_id, group)
+            st = self.jm.task_state(worker_id, group, self.job_id)
             if (st and st.get("state") == "RUNNING"
                     and int(st.get("attempt", -1)) == attempt):
                 return st
@@ -824,7 +913,7 @@ class SlotPoolScheduler:
             host, port = self._export_addr[eidx]
             feeds_spec[str(eidx)] = {"kind": "edge", "host": host,
                                      "port": port, "edge": eidx}
-        return {
+        tdd = {
             "group": group,
             "job": self.job_spec,
             "vertices": [int(v) for v in part],
@@ -839,19 +928,31 @@ class SlotPoolScheduler:
             "complete_every": self.complete_every,
             "standby_worker": self.standby.get(group),
         }
+        if self.job_id:
+            # Routes per-job worker state (slice keying, metric
+            # prefixes, per-job fencing); absent in legacy mode so the
+            # single-job wire bytes stay identical.
+            tdd["job_id"] = self.job_id
+            tdd["tenant"] = self.tenant
+        return tdd
 
     def _place(self, group: int, tdd: dict, worker_id: str,
                frame: bytes = b"") -> dict:
         """Stamp, send, await RUNNING, and wire mirror + exports."""
         attempt = self._attempts.get(group, -1) + 1
         self._attempts[group] = attempt
-        tdd = tp.attach_profile(tp.attach_audit(tp.attach_trace(
-            dict(tdd, attempt=attempt,
-                 fencing_epoch=self.election.epoch))))
+        hdr = dict(tdd, attempt=attempt, fencing_epoch=self.election.epoch)
+        # Like tp.attach_trace but through THIS job's tracer, so every
+        # worker span for this slice joins this job's trace id.
+        ctx = self._tr().wire_context()
+        if ctx is not None:
+            hdr["trace"] = ctx
+        tdd = tp.attach_profile(tp.attach_audit(hdr))
+        span_kw = {"job": self.job_id} if self.job_id else {}
         t0 = time.monotonic()
-        with get_tracer().span("deploy", group=group, worker=worker_id,
-                               attempt=attempt,
-                               recover=bool(tdd.get("recover"))):
+        with self._tr().span("deploy", group=group, worker=worker_id,
+                             attempt=attempt,
+                             recover=bool(tdd.get("recover")), **span_kw):
             self._send_deploy(worker_id, tdd, frame)
             st = self._wait_running(worker_id, group, attempt)
         self._m_deploy_ms.update((time.monotonic() - t0) * 1e3)
@@ -876,24 +977,30 @@ class SlotPoolScheduler:
     # --- deployment ----------------------------------------------------------
 
     def deploy(self, workers: Optional[List[str]] = None,
-               external_feeds: Optional[Dict[int, dict]] = None
-               ) -> Dict[int, str]:
+               external_feeds: Optional[Dict[int, dict]] = None,
+               num_slices: Optional[int] = None) -> Dict[int, str]:
         """Partition the job across the given workers (default: every
         registered worker with slot capacity, in id order) and deploy
         slice by slice in topological order — each slice's cut in-edges
         dial the export endpoints its upstream slices just reported.
-        Returns {group: worker}."""
+        ``num_slices`` decouples the cut count from the worker count
+        (a tenant may ask for fewer slices than the pool has workers,
+        or stack several slices per worker); default one slice per
+        worker. Returns {group: worker}."""
         self._require_leadership()
-        self.pool.sync_offers(self.jm.slots())
+        if self._owns_pool:
+            self.pool.sync_offers(self.jm.slots())
         workers = list(workers) if workers else self.pool.workers()
         if not workers:
             raise RuntimeError("deploy: no workers with slots registered")
-        self.parts = partition_vertices(self.job, len(workers))
+        k = int(num_slices) if num_slices else len(workers)
+        self.parts = partition_vertices(self.job, k)
         order = standby_worker_order(len(workers))
         for gi in range(len(self.parts)):
-            self.standby[gi] = workers[order[gi]]
+            self.standby[gi] = workers[order[gi % len(workers)]]
         for gi, part in enumerate(self.parts):
-            slot = self.pool.allocate(gi, prefer=workers[gi])
+            slot = self.pool.allocate(self._slot_key(gi),
+                                      prefer=workers[gi % len(workers)])
             tdd = self._descriptor(gi, part, external_feeds or {})
             self._place(gi, tdd, slot.worker_id)
         return dict(self.placements)
@@ -914,7 +1021,7 @@ class SlotPoolScheduler:
     def failed_workers(self) -> List[str]:
         placed = set(self.placements.values())
         out = [w for w in self.jm.expired() if w in placed]
-        tr = get_tracer()
+        tr = self._tr()
         if tr.enabled:
             for w in out:
                 if w not in self._detected:     # once per worker death
@@ -925,30 +1032,39 @@ class SlotPoolScheduler:
                                  if pw == w))
         return out
 
-    def recover_worker(self, dead_worker: str) -> Dict[int, str]:
+    def recover_worker(self, dead_worker: str,
+                       max_groups: Optional[int] = None
+                       ) -> Dict[int, str]:
         """A worker died: redeploy ONLY its task groups — preferring
         each group's standby worker (anti-affinity guarantees it is a
         different process) — shipping the mirrored determinant rows for
         the causal rebuild. Every other group keeps running untouched.
-        Returns {group: new worker}."""
+        ``max_groups`` caps how many groups ONE CALL redeploys (the
+        dispatcher's per-tenant concurrent-recovery cap — remaining
+        lost groups stay attributed to the dead worker and a later call
+        picks them up). Returns {group: new worker}."""
         self._require_leadership()
         lost = sorted(g for g, w in self.placements.items()
                       if w == dead_worker)
-        self.pool.drop_worker(dead_worker)
+        self.pool.drop_worker(dead_worker)       # idempotent across jobs
         self._deploy_clients.pop(dead_worker, None)
+        if max_groups is not None:
+            lost = lost[: max(0, int(max_groups))]
         with self.jm._lock:
             ignored = sorted(set(self.jm._ignored))
         moved: Dict[int, str] = {}
-        tr = get_tracer()
+        tr = self._tr()
+        span_kw = {"job": self.job_id} if self.job_id else {}
         t0 = time.monotonic()
         with tr.span("recovery.redeploy", worker=dead_worker,
-                     groups=lost):
+                     groups=lost, **span_kw):
             for group in lost:
                 target = self.standby.get(group)
                 if (target == dead_worker
                         or target not in self.pool.workers()):
                     target = None
-                slot = self.pool.allocate(group, prefer=target,
+                slot = self.pool.allocate(self._slot_key(group),
+                                          prefer=target,
                                           avoid=(dead_worker,))
                 mirror = self.mirrors[group]
                 tf = time.monotonic()
@@ -966,6 +1082,12 @@ class SlotPoolScheduler:
                 moved[group] = slot.worker_id
         self._m_redeploy_ms.update((time.monotonic() - t0) * 1e3)
         return moved
+
+    def release_pool_slots(self) -> None:
+        """Free every pool slot this job occupies (the dispatcher calls
+        this on job completion / cancellation so queued jobs admit)."""
+        for group in list(self.placements):
+            self.pool.release_group(self._slot_key(group))
 
     def close(self) -> None:
         for m in self.mirrors.values():

@@ -247,6 +247,28 @@ def test_lock_discipline_helper_called_under_lock_ok(tmp_path,
     assert res.ok
 
 
+def test_lock_discipline_del_statement_is_a_mutation(tmp_path,
+                                                     monkeypatch):
+    # `del self._jobs[jid]` shrinks guarded state just like a store
+    # does (the dispatcher's job-table pattern) — flagged when the
+    # lock is not held.
+    res = _lint_src(tmp_path, monkeypatch, """\
+        import threading
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._jobs = {}
+            def put(self, jid, rec):
+                with self._lock:
+                    self._jobs[jid] = rec
+            def evict(self, jid):
+                del self._jobs[jid]
+        """, use_waivers=False)
+    (f,) = _hits(res, "lock-discipline")
+    assert f.line == 10 and "deletes from" in f.message
+    assert "_jobs" in f.message
+
+
 def test_lock_discipline_init_exempt_and_unlocked_class_quiet(
         tmp_path, monkeypatch):
     res = _lint_src(tmp_path, monkeypatch, """\
