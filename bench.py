@@ -385,7 +385,123 @@ def multi_job_probe(n_jobs: int):
     return out
 
 
-def main(jobs=None):
+def multichip_probe(n_devices: int = 8):
+    """Mesh-sharding probe (``bench.py --multichip [N]``): the SAME job
+    run twice — once on a 1-device task mesh, once sharded over an
+    N-device mesh (rule-driven PartitionSpec tree over carry, causal
+    logs, and in-flight rings; parallel/distributed.py) — with the audit
+    ledger sealing every epoch in both runs. Reports aggregate and
+    per-shard steady-state throughput, the speedup and scaling
+    efficiency, and whether the sharded run's sealed epoch digests are
+    bit-identical to the unsharded run's (``diff_ledgers`` empty — the
+    exactly-once fence contract is sharding-invariant).
+
+    On a host with fewer than N devices the probe re-execs itself in a
+    child forcing ``--xla_force_host_platform_device_count=N`` (the
+    tests/conftest.py recipe), so it runs everywhere — including a
+    single-CPU box, where the honest speedup is ~1x (virtual devices
+    share one core; the digest-equality half is load-bearing there)."""
+    import gc
+    import subprocess
+    import tempfile
+
+    import jax
+
+    if len(jax.devices()) < n_devices:
+        env = dict(os.environ)
+        kept = [f for f in env.get("XLA_FLAGS", "").split()
+                if "xla_force_host_platform_device_count" not in f]
+        kept.append(f"--xla_force_host_platform_device_count={n_devices}")
+        env["XLA_FLAGS"] = " ".join(kept)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--multichip", str(n_devices)],
+            env=env, cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"multichip child failed (rc={proc.returncode}):\n"
+                f"{proc.stderr[-2000:]}")
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+
+    from clonos_tpu.obs.digest import diff_ledgers
+    from clonos_tpu.parallel import distributed as dist
+    from clonos_tpu.runtime.cluster import ClusterRunner
+    from clonos_tpu.runtime.executor import DETS_PER_STEP
+
+    SPE = int(os.environ.get("BENCH_MC_SPE", 512))
+    EPOCHS = int(os.environ.get("BENCH_MC_EPOCHS", 3))
+
+    def run_one(ndev: int, ckdir: str):
+        from clonos_tpu.api.environment import StreamEnvironment
+        env = StreamEnvironment(name="bench-mc", num_key_groups=64,
+                                default_edge_capacity=512)
+        (env.synthetic_source(vocab=997, batch_size=BATCH, parallelism=PAR)
+            .key_by()
+            .window_count(num_keys=997, window_size=1 << 30, name="window")
+            .key_by()
+            .reduce(num_keys=997, name="reduce")
+            .sink())
+        runner = ClusterRunner(
+            env.build(), steps_per_epoch=SPE,
+            log_capacity=1 << (2 * SPE * DETS_PER_STEP).bit_length(),
+            max_epochs=EPOCHS + 8,
+            inflight_ring_steps=1 << (2 * SPE - 1).bit_length(),
+            checkpoint_dir=ckdir, audit=True,
+            mesh=dist.task_mesh(max_devices=ndev),
+            logical_time=True, seed=7)
+        runner.run_epoch(complete_checkpoint=True)   # compile warmup
+        device_sync(runner.executor.carry)
+        t0 = time.monotonic()
+        for _ in range(EPOCHS):
+            runner.run_epoch(complete_checkpoint=True)
+        device_sync(runner.executor.carry)
+        wall = time.monotonic() - t0
+        shards = runner.per_shard_health()
+        ledger = runner.coordinator.read_ledger()
+        rate = EPOCHS * SPE * PAR * BATCH / wall
+        rec_total = max(
+            1, int(np.asarray(runner.executor.carry.record_counts).sum()))
+        per_shard = None
+        if shards is not None and ndev > 1:
+            # Deal the aggregate rate out by each shard's actual record
+            # share (the mesh partitions work, not just storage).
+            per_shard = [round(rate * int(s) / rec_total, 1)
+                         for s in np.asarray(shards)[:, 0]]
+        del runner
+        gc.collect()
+        return rate, per_shard, ledger
+
+    with tempfile.TemporaryDirectory() as td:
+        rate_1, _ps1, ledger_1 = run_one(1, os.path.join(td, "m1"))
+        rate_n, per_shard, ledger_n = run_one(n_devices,
+                                              os.path.join(td, "mn"))
+    problems = diff_ledgers(ledger_1, ledger_n)
+    return {
+        "metric": "multichip_aggregate_records_per_sec",
+        "value": round(rate_n, 1),
+        "unit": "records/sec (sharded over the task mesh)",
+        "n_devices": n_devices,
+        "records_per_sec_1dev": round(rate_1, 1),
+        "records_per_sec_sharded": round(rate_n, 1),
+        "per_shard_records_per_sec": per_shard,
+        "speedup": round(rate_n / rate_1, 3) if rate_1 else None,
+        "scaling_efficiency": (round(rate_n / rate_1 / n_devices, 3)
+                               if rate_1 else None),
+        "digests_equal": not problems,
+        "ledger_problems": problems[:8],
+        "epochs_sealed": min(len(ledger_1), len(ledger_n)),
+        "steps_per_epoch": SPE,
+    }
+
+
+def main(jobs=None, multichip=None):
+    if multichip:
+        # --multichip [N]: run ONLY the mesh-sharding probe (one JSON
+        # line, same contract as the headline bench).
+        print(json.dumps(multichip_probe(int(multichip))))
+        return
     if jobs:
         # --jobs N: run ONLY the multi-job probe (one JSON line, same
         # contract as the headline bench).
@@ -593,5 +709,10 @@ if __name__ == "__main__":
     ap.add_argument("--jobs", type=int, default=None,
                     help="run the multi-job throughput probe with N "
                          "concurrent jobs instead of the headline bench")
+    ap.add_argument("--multichip", type=int, nargs="?", const=8,
+                    default=None, metavar="N",
+                    help="run the mesh-sharding probe over N devices "
+                         "(forcing N host devices when needed) instead "
+                         "of the headline bench")
     _a = ap.parse_args()
-    sys.exit(main(jobs=_a.jobs))
+    sys.exit(main(jobs=_a.jobs, multichip=_a.multichip))

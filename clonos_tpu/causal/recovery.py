@@ -216,6 +216,30 @@ class ReplayResult:
                 f"{want[bad].tolist()})")
 
 
+def plan_restore_nbytes(plan: ReplayPlan) -> int:
+    """Bytes this plan's shard-local restore moves: the failed subtask's
+    slice of the checkpointed vertex state (one row of the [P, ...]
+    pytree — healthy subtasks' rows stay in their live buffers), the
+    recovered determinant stream, and the replayed input windows. The
+    per-shard numerator of RecoveryReport.restore_bytes; compare against
+    checkpoint.carry_nbytes of the full snapshot to see what a global
+    rollback would have moved instead."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(plan.checkpoint_op_state):
+        n0 = getattr(leaf, "shape", (1,))[0] if getattr(
+            leaf, "ndim", 0) > 0 else 1
+        total += int(getattr(leaf, "nbytes", 0)) // max(1, n0)
+    if plan.det_rows is not None and getattr(plan.det_rows, "size", 0):
+        total += int(plan.det_rows.nbytes)
+    elif plan.det_device is not None:
+        total += sum(int(np.prod(x.shape)) * 4
+                     for x in plan.det_device if hasattr(x, "shape"))
+    if plan.input_steps is not None:
+        for leaf in jax.tree_util.tree_leaves(plan.input_steps):
+            total += int(getattr(leaf, "nbytes", 0))
+    return total
+
+
 class LogReplayer:
     """Serves recorded determinants back and drives the on-device replay
     (reference LogReplayer/LogReplayerImpl.java:36-157). Replay runs the

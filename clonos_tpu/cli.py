@@ -10,7 +10,7 @@ entrypoint under ``jax.distributed`` (see parallel/distributed.py).
 Usage:
     python -m clonos_tpu run <module:function> [--steps N] [--epochs N] ...
     python -m clonos_tpu info <module:function>
-    python -m clonos_tpu bench [--jobs N]
+    python -m clonos_tpu bench [--jobs N] [--multichip [N]]
     python -m clonos_tpu dryrun [--devices N]
     python -m clonos_tpu dispatcher --lease DIR [--quota TENANT=N ...]
     python -m clonos_tpu submit <module:function> --dispatcher HOST:PORT
@@ -144,7 +144,8 @@ def cmd_info(args) -> int:
 
 def cmd_bench(args) -> int:
     import bench
-    bench.main(jobs=getattr(args, "jobs", None))
+    bench.main(jobs=getattr(args, "jobs", None),
+               multichip=getattr(args, "multichip", None))
     return 0
 
 
@@ -840,6 +841,12 @@ def main(argv=None) -> int:
                     help="run ONLY the multi-job throughput probe with "
                          "N concurrent in-process jobs (per-tenant "
                          "steady-state records/sec + fairness ratio)")
+    pb.add_argument("--multichip", type=int, nargs="?", const=8,
+                    default=None, metavar="N",
+                    help="run ONLY the mesh-sharding probe over N "
+                         "devices (per-shard throughput, scaling "
+                         "efficiency, sealed-digest equality vs the "
+                         "1-device run)")
     pb.set_defaults(fn=cmd_bench)
 
     pd = sub.add_parser("dryrun", help="multichip sharding dry run")

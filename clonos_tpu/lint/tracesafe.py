@@ -54,11 +54,20 @@ MUTATORS = {"append", "extend", "add", "update", "insert", "remove",
             "discard", "clear", "pop", "popleft", "appendleft",
             "setdefault", "write"}
 
+#: decorators that put a def's body under trace: plain jit plus the
+#: SPMD wrappers (pjit, shard_map) used by the mesh-sharded block
+#: programs — a host branch inside any of them fails the same way.
+TRACED_DECORATORS = frozenset({
+    "jax.jit", "jit",
+    "pjit", "jax.pjit", "jax.experimental.pjit.pjit",
+    "shard_map", "jax.experimental.shard_map.shard_map",
+})
+
 
 def _traced_roots(ctx: FileContext) -> List[Tuple[ast.AST, Set[str]]]:
     """(function node, traced param names) for every step-function body
-    in the file: operator methods, jitted defs, and combinator
-    lambdas/defs."""
+    in the file: operator methods, jit/pjit/shard_map-wrapped defs, and
+    combinator lambdas/defs."""
     roots: List[Tuple[ast.AST, Set[str]]] = []
     module_defs = {n.name: n for n in ctx.tree.body
                    if isinstance(n, ast.FunctionDef)}
@@ -71,7 +80,7 @@ def _traced_roots(ctx: FileContext) -> List[Tuple[ast.AST, Set[str]]]:
         elif isinstance(node, ast.FunctionDef):
             for dec in node.decorator_list:
                 target = dec.func if isinstance(dec, ast.Call) else dec
-                if ctx.resolve(target) in {"jax.jit", "jit"}:
+                if ctx.resolve(target) in TRACED_DECORATORS:
                     roots.append((node, _params(node)))
         elif isinstance(node, ast.Call) \
                 and isinstance(node.func, ast.Attribute) \

@@ -25,10 +25,13 @@ global mesh (SPMD); per-host Python only feeds host-local step inputs.
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional, Sequence
+import hashlib
+import re
+from typing import Any, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
 
 
 @dataclasses.dataclass
@@ -78,6 +81,120 @@ def hierarchical_mesh(axis_tasks: str = "tasks",
     per_host = len(devs) // n_hosts
     grid = np.asarray(devs).reshape(n_hosts, per_host)
     return jax.sharding.Mesh(grid, (axis_hosts, axis_tasks))
+
+
+# --- rule-driven carry partitioning -----------------------------------------
+#
+# The executor's JobCarry is a deep pytree whose leaves disagree about
+# WHICH axis is the subtask axis: stacked causal logs / replicas lead
+# with it ([L, cap, lanes]), in-flight ring tensors carry it second
+# ([S, P, cap] — the leading axis is the ring step), round-robin cursors
+# and ring scalars are control state that every shard must see. A single
+# "shard the leading axis" heuristic therefore cannot express the
+# deployment; these RULES can: ordered (regex over the '/'-joined leaf
+# path, shard dim | None) pairs, first match wins, unmatched leaves
+# replicate. The same table drives with_sharding_constraint inside the
+# traced block program AND the explicit in/out shardings on the jitted
+# entry points, so the two can never disagree.
+
+#: (path regex, dim to shard along the task axis; None = replicate).
+CARRY_PARTITION_RULES: Tuple[Tuple[str, Optional[int]], ...] = (
+    # In-flight ring payload tensors are [ring_step, subtask, cap].
+    (r"out_rings/\d+/(keys|values|timestamps|valid)$", 1),
+    # Ring bookkeeping (head/tail/epoch index) is scalar control state.
+    (r"out_rings/", None),
+    # Stacked causal logs + determinant replicas lead with the task axis.
+    (r"(^|/)(logs|replicas)/", 0),
+    # Rebalance cursors are [1] scalars shared by the whole edge.
+    (r"rr_offsets/", None),
+    # Operator state / depth-1 edge buffers / record counts lead with
+    # the (destination) subtask axis.
+    (r"(^|/)(op_states|edge_bufs|record_counts)($|/)", 0),
+)
+
+
+def _path_str(path: Tuple[Any, ...]) -> str:
+    """Render a tree_flatten_with_path key path as 'a/0/b' — attribute
+    names for NamedTuple/dataclass fields, indices for sequences, keys
+    for dicts — the namespace the partition-rule regexes match against."""
+    parts = []
+    for k in path:
+        if hasattr(k, "name"):                 # GetAttrKey / DictKey-like
+            parts.append(str(k.name))
+        elif hasattr(k, "idx"):                # SequenceKey
+            parts.append(str(k.idx))
+        elif hasattr(k, "key"):                # DictKey / FlattenedIndexKey
+            parts.append(str(k.key))
+        else:                                  # pragma: no cover
+            parts.append(str(k))
+    return "/".join(parts)
+
+
+def _spec_for_leaf(path_s: str, leaf: Any, n: int, axis: str,
+                   rules: Sequence[Tuple[str, Optional[int]]]
+                   ) -> PartitionSpec:
+    """First matching rule decides the shard dim; a dim the leaf lacks or
+    cannot split evenly over the ``n`` mesh devices degrades to
+    replication (same guard the in-trace constraint applies, so explicit
+    jit shardings and with_sharding_constraint always agree)."""
+    ndim = getattr(leaf, "ndim", None)
+    if ndim is None:
+        ndim = np.ndim(leaf)
+    shape = getattr(leaf, "shape", ())
+    for pat, dim in rules:
+        if re.search(pat, path_s):
+            if dim is None or ndim <= dim or shape[dim] == 0 \
+                    or shape[dim] % n != 0:
+                return PartitionSpec()
+            return PartitionSpec(*([None] * dim + [axis]))
+    return PartitionSpec()
+
+
+def infer_partition_spec(tree: Any, mesh: jax.sharding.Mesh,
+                         axis: str = "tasks",
+                         rules: Sequence[Tuple[str, Optional[int]]]
+                         = CARRY_PARTITION_RULES) -> Any:
+    """PartitionSpec pytree for ``tree`` (same structure), derived from
+    the rule table over flattened leaf names. Scalars and indivisible
+    leaves replicate."""
+    n = mesh.shape[axis]
+    leaves, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    specs = [_spec_for_leaf(_path_str(p), x, n, axis, rules)
+             for p, x in leaves]
+    return jax.tree_util.tree_unflatten(treedef, specs)
+
+
+def named_shardings(tree: Any, mesh: jax.sharding.Mesh,
+                    axis: str = "tasks",
+                    rules: Sequence[Tuple[str, Optional[int]]]
+                    = CARRY_PARTITION_RULES) -> Any:
+    """NamedSharding pytree over ``mesh`` for ``tree`` — the form
+    ``jax.jit``'s in/out_shardings and ``device_put`` take."""
+    specs = infer_partition_spec(tree, mesh, axis=axis, rules=rules)
+    return jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), specs,
+        is_leaf=lambda s: isinstance(s, PartitionSpec))
+
+
+def mesh_fingerprint(mesh: Optional[jax.sharding.Mesh]) -> str:
+    """Stable short id of a mesh's topology: axis names, axis sizes, and
+    device kind — what XLA partitioning actually depends on (NOT device
+    ordinals, so equivalent meshes on different hosts key identically)."""
+    if mesh is None:
+        return "nomesh"
+    kinds = sorted({d.platform for d in mesh.devices.flat})
+    desc = f"{tuple(mesh.axis_names)}|{tuple(mesh.devices.shape)}|{kinds}"
+    return hashlib.blake2b(desc.encode(), digest_size=6).hexdigest()
+
+
+def spec_fingerprint(specs: Any) -> str:
+    """Stable short id of a PartitionSpec pytree (structure + every
+    spec), for compile-cache keying: sharded and unsharded lowerings of
+    the same HLO-shaped program must never collide."""
+    leaves, treedef = jax.tree_util.tree_flatten(
+        specs, is_leaf=lambda s: isinstance(s, PartitionSpec))
+    desc = repr(treedef) + "|" + "|".join(repr(s) for s in leaves)
+    return hashlib.blake2b(desc.encode(), digest_size=6).hexdigest()
 
 
 def standby_device_order(mesh: jax.sharding.Mesh,

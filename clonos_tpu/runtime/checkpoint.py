@@ -276,6 +276,30 @@ def carry_nbytes(host_carry) -> int:
                if hasattr(x, "nbytes"))
 
 
+def snapshot_subtask_slice(snapshot, vertex_id: int, subtask: int) -> Any:
+    """The one-subtask slice of a LeanSnapshot's vertex state — what a
+    rehydrating standby actually restores under mesh sharding (the failed
+    chip's row of the [P, ...] pytree), while healthy shards keep their
+    live buffers. Returns a pytree of [1, ...] leaves."""
+    return jax.tree_util.tree_map(
+        lambda x: x[subtask][None] if getattr(x, "ndim", 0) > 0 else x,
+        snapshot.op_states[vertex_id])
+
+
+def snapshot_subtask_nbytes(snapshot, vertex_id: int, subtask: int) -> int:
+    """Bytes of :func:`snapshot_subtask_slice` WITHOUT materializing it:
+    one leading-axis row of every vertex-state leaf. The per-shard
+    restore cost a RecoveryReport compares against
+    :func:`carry_nbytes` of the full snapshot."""
+    total = 0
+    for x in jax.tree_util.tree_leaves(snapshot.op_states[vertex_id]):
+        if not hasattr(x, "nbytes"):
+            continue
+        n0 = x.shape[0] if getattr(x, "ndim", 0) > 0 else 1
+        total += int(x.nbytes) // max(1, n0)
+    return total
+
+
 class CheckpointCoordinator:
     """Host control plane for checkpoints.
 

@@ -170,6 +170,15 @@ class RecoveryReport:
     #: True for failover rehearsals (failover_drill): excluded from the
     #: recovery metrics and the reports ledger.
     drill: bool = False
+    #: bytes the shard-local restore actually moved: the failed subtasks'
+    #: checkpoint slices + fetched determinant rows + replayed input
+    #: windows. The paper's local-recovery claim in one number —
+    #: ``restore_bytes < checkpoint_bytes`` says healthy shards kept
+    #: their live buffers instead of rolling back.
+    restore_bytes: int = 0
+    #: bytes of the FULL checkpointed carry a global rollback would have
+    #: re-loaded (the denominator for restore_bytes).
+    checkpoint_bytes: int = 0
 
 
 class OverflowError_(RuntimeError):
@@ -294,6 +303,23 @@ class ClusterRunner:
         g.gauge("backpressure.inflight-occupancy", self._inflight_occupancy)
         g.gauge("recovery.replay-lag-steps", self._replay_lag_steps)
         self.watchdog = met.LogOccupancyWatchdog(self.executor, g)
+        # Per-mesh-shard health (mesh-sharded fused blocks): one gauge
+        # triple per task-axis shard, fed from the executor's packed
+        # [n, 3] per-shard read, cached per epoch so a metrics scrape
+        # costs at most one device round-trip per fence.
+        self._shard_health: Optional[np.ndarray] = None
+        self._shard_health_epoch = -1
+        mesh_ = self.executor.compiled.mesh
+        if mesh_ is not None:
+            n_sh = mesh_.shape[self.executor.compiled.task_axis]
+            g.gauge("mesh.shards", lambda n_sh=n_sh: n_sh)
+            for i in range(n_sh):
+                g.gauge(f"shard.{i}.records",
+                        lambda i=i: int(self.per_shard_health()[i, 0]))
+                g.gauge(f"shard.{i}.log-rows",
+                        lambda i=i: int(self.per_shard_health()[i, 1]))
+                g.gauge(f"shard.{i}.ring-slots",
+                        lambda i=i: int(self.per_shard_health()[i, 2]))
         #: compiled recovery programs, keyed by (kind, params) — populated
         #: lazily and by prewarm_recovery() (warm standby: no XLA compile
         #: in the failure path).
@@ -404,6 +430,19 @@ class ClusterRunner:
             return self.global_step
         f = self._fence_step.get(ck.checkpoint_id + 1)
         return self.global_step - f if f is not None else 0
+
+    def per_shard_health(self) -> Optional[np.ndarray]:
+        """int32 [n_shards, 3] (records, live log rows, live ring slots)
+        per task-axis mesh shard, cached per epoch (the shard.<i>.*
+        gauges all read through this, so a full metrics scrape costs one
+        device round-trip, not 3n). None without a mesh."""
+        if self.executor.compiled.mesh is None:
+            return None
+        if self._shard_health_epoch != self.executor.epoch_id \
+                or self._shard_health is None:
+            self._shard_health = self.executor.per_shard_health()
+            self._shard_health_epoch = self.executor.epoch_id
+        return self._shard_health
 
     # --- compiled recovery programs ------------------------------------------
 
@@ -1408,6 +1447,13 @@ class ClusterRunner:
         managers: List[rec.RecoveryManager] = []
         total_dets = 0
         total_records = 0
+        # Shard-local restore accounting: bytes each failed subtask's
+        # rehydration actually moves vs the full snapshot a global
+        # rollback would re-load (the paper's local-recovery claim as a
+        # measurable ratio; surfaces on the RecoveryReport).
+        restore_bytes = 0
+        checkpoint_bytes = (int(getattr(ckpt, "size_bytes", 0) or 0)
+                            or cp.carry_nbytes(ckpt.carry))
         phases: Dict[str, float] = {}
 
         def _clock(name: str, since: float) -> float:
@@ -1673,6 +1719,7 @@ class ClusterRunner:
                 checkpoint_op_state=snap.op_states[vid],
                 n_steps=n_steps, verify_outputs=not synthesized,
                 det_device=det_device)
+            restore_bytes += rec.plan_restore_nbytes(plan)
             # Fast path: replay dispatches only — output-cut verification
             # and the consumed total ride the final packed read.
             result = mgr.run_replay(plan, defer_sync=fast)
@@ -1874,7 +1921,8 @@ class ClusterRunner:
             records_replayed=total_records,
             ignored_checkpoints=ignored,
             recovery_ms=(_time.monotonic() - t0) * 1e3,
-            managers=tuple(managers), phase_ms=phases, drill=drill)
+            managers=tuple(managers), phase_ms=phases, drill=drill,
+            restore_bytes=restore_bytes, checkpoint_bytes=checkpoint_bytes)
         if not drill:
             # Rehearsals must not inflate the recovery count/latency
             # series operators alert on.
@@ -2085,6 +2133,14 @@ class ClusterRunner:
         with ThreadPoolExecutor(max_workers=4) as pool:
             for res in pool.map(lambda j: j(), jobs):
                 pass
+        if compiled.mesh is not None:
+            # Mesh-sharded jobs: AOT-lower the standby's sharded
+            # first-step (block) program into the persistent compile
+            # cache too — the rehydrated standby's first dispatch after
+            # restore is then a cache hit, not the finalize-tail
+            # recompile BENCH_r05 attributes ~448 ms to.
+            from clonos_tpu.utils.compile_cache import aot_lower_first_step
+            aot_lower_first_step(self.executor)
         return _time.monotonic() - t0
 
     def failover_drill(self, flats: Optional[Sequence[int]] = None

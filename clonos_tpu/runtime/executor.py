@@ -259,6 +259,37 @@ class CompiledJob:
             lambda x: self._shard_axis(x, 1) if getattr(x, "ndim", 0) > 1
             else x, tree)
 
+    def carry_partition_spec(self, carry: JobCarry):
+        """Rule-driven PartitionSpec pytree for the full carry
+        (parallel/distributed.py:CARRY_PARTITION_RULES — regex over
+        flattened leaf names; scalars and indivisible dims replicate).
+        None when no mesh is attached."""
+        if self.mesh is None:
+            return None
+        from clonos_tpu.parallel import distributed as dist
+        return dist.infer_partition_spec(carry, self.mesh,
+                                         axis=self.task_axis)
+
+    def carry_shardings(self, carry: JobCarry):
+        """NamedSharding pytree over the task mesh for the full carry
+        (the form jit in/out_shardings take), or None without a mesh."""
+        if self.mesh is None:
+            return None
+        from clonos_tpu.parallel import distributed as dist
+        return dist.named_shardings(carry, self.mesh, axis=self.task_axis)
+
+    def constrain_carry(self, carry: JobCarry) -> JobCarry:
+        """Constrain EVERY carry leaf to its rule-assigned sharding —
+        logs/replicas on their leading task axis, ring payloads on their
+        subtask axis (1), control scalars replicated. Applied at carry
+        construction and at the end of every block so the traced
+        program's layout always matches the explicit jit shardings."""
+        if self.mesh is None:
+            return carry
+        shardings = self.carry_shardings(carry)
+        return jax.tree_util.tree_map(
+            jax.lax.with_sharding_constraint, carry, shardings)
+
     # --- initialization -----------------------------------------------------
 
     def init_carry(self) -> JobCarry:
@@ -285,7 +316,7 @@ class CompiledJob:
         carry = JobCarry(op_states, edge_bufs, rr,
                          jnp.zeros((self.L,), jnp.int32), logs, out_rings,
                          replicas)
-        return self._shard_tree(carry)
+        return self.constrain_carry(carry)
 
     # --- the block program --------------------------------------------------
 
@@ -393,7 +424,17 @@ class CompiledJob:
                 # consumers re-derive their input by re-running the
                 # deterministic exchange during replay.
                 ri = self.ring_index[vid]
-                out_rings[ri] = ifl.append_block(out_rings[ri], out)
+                el = ifl.append_block(out_rings[ri], out)
+                # Re-pin the ring payload to its subtask axis (axis 1):
+                # append_block's scatter would otherwise let the
+                # partitioner re-layout the [S, P, cap] tensors along the
+                # ring-step axis, splitting every step's batch across
+                # chips instead of keeping each subtask's lane local.
+                out_rings[ri] = el._replace(
+                    keys=self._shard_axis(el.keys, 1),
+                    values=self._shard_axis(el.values, 1),
+                    timestamps=self._shard_axis(el.timestamps, 1),
+                    valid=self._shard_axis(el.valid, 1))
 
         # Determinant block: one [L, K*4, lanes] tensor, two bulk appends.
         emits_all = jnp.concatenate(
@@ -418,6 +459,7 @@ class CompiledJob:
             tuple(op_states), tuple(new_edge_bufs), tuple(rr_offsets),
             carry.record_counts + consumed_all.sum(axis=0), logs,
             tuple(out_rings), replicas)
+        new_carry = self.constrain_carry(new_carry)
         return new_carry, BlockOutputs(sinks, dropped, consumed_all)
 
     def _det_rows(self, binputs: BlockInputs, emits_all: jnp.ndarray
@@ -588,12 +630,48 @@ class LocalExecutor:
         #: supersteps actually executed (the staged epoch path pre-fills
         #: step_input_history, so len(history) over-counts mid-epoch).
         self._steps_executed = 0
+        # Explicit shardings for every jitted entry point when a mesh is
+        # attached: the carry rides its rule-driven NamedSharding tree
+        # (parallel/distributed.py rules — the SAME table the in-trace
+        # constraints use, so entry layout and traced layout can never
+        # disagree), host-fed step inputs replicate. Donation stays on:
+        # input and output carry shardings match leaf-for-leaf, so XLA
+        # aliases the GB-scale buffers shard-locally (no cross-chip copy
+        # at the donate boundary).
+        self._carry_ns = self.compiled.carry_shardings(self.carry)
+        self._repl_ns = (jax.sharding.NamedSharding(
+            mesh, jax.sharding.PartitionSpec())
+            if mesh is not None else None)
+
+        def _mesh_kw(in_shardings, out_shardings=None):
+            if mesh is None:
+                return {}
+            kw = {"in_shardings": in_shardings}
+            if out_shardings is not None:
+                kw["out_shardings"] = out_shardings
+            return kw
+
+        def _ns0(x):
+            # Leading-axis task sharding for a plain array arg (the
+            # stacked-log storage the async-append program touches),
+            # with the same divisibility guard the rule table applies.
+            if mesh is None:
+                return None
+            n = mesh.shape[self.compiled.task_axis]
+            shp = getattr(x, "shape", ())
+            if len(shp) >= 1 and shp[0] % n == 0 and shp[0] > 0:
+                return jax.sharding.NamedSharding(
+                    mesh,
+                    jax.sharding.PartitionSpec(self.compiled.task_axis))
+            return self._repl_ns
+
         # The carry is donated: the block program updates GB-scale log /
         # ring storage in place instead of copying it every call (the
         # carry's buffers are only ever referenced by the live executor;
         # checkpoints deep-copy what they keep — lean_snapshot).
-        self._jit_block = jax.jit(self.compiled.run_block,
-                                  donate_argnums=0)
+        self._jit_block = jax.jit(
+            self.compiled.run_block, donate_argnums=0,
+            **_mesh_kw((self._carry_ns, self._repl_ns)))
 
         plan = self.compiled.plan
 
@@ -627,8 +705,12 @@ class LocalExecutor:
                                 for el in carry.out_rings),
                 replicas=replicas)
 
-        self._jit_roll = jax.jit(_roll, donate_argnums=0)
-        self._jit_trunc = jax.jit(_trunc, donate_argnums=0)
+        self._jit_roll = jax.jit(
+            _roll, donate_argnums=0,
+            **_mesh_kw((self._carry_ns, self._repl_ns), self._carry_ns))
+        self._jit_trunc = jax.jit(
+            _trunc, donate_argnums=0,
+            **_mesh_kw((self._carry_ns, self._repl_ns), self._carry_ns))
         # Host-side spill owners, one per ring vertex (None = disabled).
         self.spill_policy = spill_policy
         self.spill_logs: Optional[List[ifl.SpillingInFlightLog]] = None
@@ -689,8 +771,15 @@ class LocalExecutor:
                 rep_heads = rep_heads + rcounts
             return log_rows, log_heads, rep_rows, rep_heads
 
-        self._jit_append_many = jax.jit(_append_many,
-                                        donate_argnums=(0, 2))
+        c0 = self.carry
+        self._jit_append_many = jax.jit(
+            _append_many, donate_argnums=(0, 2),
+            **_mesh_kw(
+                (_ns0(c0.logs.rows), _ns0(c0.logs.head),
+                 _ns0(c0.replicas.rows), _ns0(c0.replicas.head),
+                 _ns0(c0.logs.rows), _ns0(c0.logs.head)),
+                (_ns0(c0.logs.rows), _ns0(c0.logs.head),
+                 _ns0(c0.replicas.rows), _ns0(c0.replicas.head))))
 
         bs = self.block_steps
 
@@ -707,7 +796,9 @@ class LocalExecutor:
             carry, outs = self.compiled.run_block(carry, bi)
             return carry, outs, lo + bs
 
-        self._jit_staged_run = jax.jit(_staged_run, donate_argnums=0)
+        self._jit_staged_run = jax.jit(
+            _staged_run, donate_argnums=0,
+            **_mesh_kw((self._carry_ns,) + (self._repl_ns,) * 5))
 
     def register_feed(self, vertex_id: int, reader) -> None:
         """Attach a rewindable reader (api/feeds.py) to a HostFeedSource
@@ -983,6 +1074,44 @@ class LocalExecutor:
         if not hasattr(self, "_jit_health"):
             self._jit_health = jax.jit(self._health_vector)
         return np.asarray(self._jit_health(self.carry))
+
+    def _per_shard_health(self, carry: JobCarry) -> jnp.ndarray:
+        """Pure: int32 [n_shards, 3] — records processed, live causal-log
+        rows, live in-flight ring slots — summed over the task-axis block
+        each mesh shard owns. One packed device value, same rationale as
+        :meth:`_health_vector`: the control plane pays one read to learn
+        which chip is hot, lagging, or about to overflow."""
+        n = self.compiled.mesh.shape[self.compiled.task_axis]
+        L = self.compiled.L
+        g = -(-L // n)                       # block size (ceil for pad)
+        pad = g * n - L
+
+        def blocks(x):                       # [L] -> [n] block sums
+            return jnp.pad(x, (0, pad)).reshape(n, g).sum(axis=1)
+
+        rec = blocks(carry.record_counts)
+        rows = blocks(carry.logs.head - carry.logs.tail)
+        ring = jnp.zeros((n,), jnp.int32)
+        for el in carry.out_rings:
+            p = el.valid.shape[1]
+            gp = -(-p // n)
+            padp = gp * n - p
+            v = jnp.pad(el.valid.astype(jnp.int32),
+                        ((0, 0), (0, padp), (0, 0)))
+            ring = ring + v.reshape(v.shape[0], n, gp,
+                                    v.shape[2]).sum(axis=(0, 2, 3))
+        return jnp.stack([rec, rows, ring], axis=1)
+
+    def per_shard_health(self) -> Optional[np.ndarray]:
+        """int32 [n_shards, 3] (records, log rows, ring occupancy) per
+        mesh shard along the task axis; None without a mesh (the job is
+        one implicit shard). Shards are the contiguous task-axis blocks
+        the rule-driven PartitionSpec deals to each device."""
+        if self.compiled.mesh is None:
+            return None
+        if not hasattr(self, "_jit_shard_health"):
+            self._jit_shard_health = jax.jit(self._per_shard_health)
+        return np.asarray(self._jit_shard_health(self.carry))
 
     def overflow_messages(self, vec: np.ndarray) -> List[str]:
         """Decode :meth:`health_vector` flags into violation strings."""

@@ -4,15 +4,43 @@ The failure path is prewarm-compiled at job start; with this cache a
 RESTARTED job pays near-zero for those compiles (the reference's standby
 deploy analog survives process restarts). Safe to share across backends:
 JAX keys entries by HLO + compile-options hash.
+
+Mesh-sharded programs get a cache *namespace* of their own: JAX's entry
+key covers HLO + compile options, but a program lowered under an
+8-device mesh and its single-device twin can share module text while
+their executables are incompatible across partitioner versions — so
+:func:`enable_compile_cache` accepts the mesh + PartitionSpec pytree
+and keys a per-sharding subdirectory from their fingerprints. Unsharded
+and sharded runs therefore never collide in the persistent cache.
 """
 
 from __future__ import annotations
 
 import os
+from typing import Any, Optional
 
 
-def enable_compile_cache(cache_dir: str) -> None:
+def sharding_cache_key(mesh: Optional[Any] = None,
+                       specs: Optional[Any] = None) -> str:
+    """Cache-namespace token for a (mesh, PartitionSpec pytree) pair.
+    ``None``/``None`` (the single-device program) gets its own stable
+    token, so turning sharding on or off switches namespaces."""
+    from clonos_tpu.parallel.distributed import (mesh_fingerprint,
+                                                 spec_fingerprint)
+    mk = mesh_fingerprint(mesh)
+    sk = spec_fingerprint(specs) if specs is not None else "nospec"
+    return f"{mk}-{sk}"
+
+
+def enable_compile_cache(cache_dir: str, mesh: Optional[Any] = None,
+                         specs: Optional[Any] = None) -> str:
+    """Point JAX's persistent compile cache at ``cache_dir`` — suffixed
+    with :func:`sharding_cache_key` when a mesh (and optionally the
+    carry's PartitionSpec pytree) is given. Returns the directory used."""
     import jax
+    if mesh is not None or specs is not None:
+        cache_dir = os.path.join(cache_dir,
+                                 sharding_cache_key(mesh, specs))
     os.makedirs(cache_dir, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", cache_dir)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
@@ -20,3 +48,30 @@ def enable_compile_cache(cache_dir: str) -> None:
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
     except Exception:                              # pragma: no cover
         pass  # knob name varies across jax versions
+    return cache_dir
+
+
+def aot_lower_first_step(executor) -> Optional[Any]:
+    """Ahead-of-time lower + compile the standby's FIRST-STEP program —
+    the sharded block program a rehydrating standby dispatches before
+    anything else — so its executable is in the persistent cache (and
+    XLA's in-process cache) before any failure happens. BENCH_r05 puts
+    first-step-recompile inside the dominant ~448 ms finalize tail; a
+    cache hit removes it.
+
+    Lowering uses the executor's live carry avals + shardings (no
+    execution, no donation — ``lower`` only traces). Returns the
+    compiled executable, or None when lowering is unsupported on this
+    backend/version (callers treat AOT warmup as best-effort)."""
+    import jax.numpy as jnp
+
+    from clonos_tpu.runtime.executor import BlockInputs
+    try:
+        k = executor.block_steps
+        bi = BlockInputs(times=jnp.zeros((k,), jnp.int32),
+                         rng_bits=jnp.zeros((k,), jnp.int32),
+                         epoch=jnp.zeros((), jnp.int32),
+                         step0=jnp.zeros((), jnp.int32), feeds=())
+        return executor._jit_block.lower(executor.carry, bi).compile()
+    except Exception:                              # pragma: no cover
+        return None

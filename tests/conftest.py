@@ -55,3 +55,15 @@ def pytest_configure(config):
         raise pytest.UsageError(
             "determinism lint failed (clonos_tpu lint):\n"
             + format_text(result))
+
+
+@pytest.fixture
+def eight_devices():
+    """The 8 virtual host devices the multi-device (mesh-sharded) tests
+    run on. XLA_FLAGS above forces the count before the backend
+    initializes; if something else initialized it first (e.g. a real
+    single-chip backend), skip rather than fail."""
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip(f"needs 8 devices, have {len(devs)}")
+    return devs[:8]

@@ -55,4 +55,80 @@ def test_mesh_execution_matches_single_device():
 @pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 devices")
 def test_graft_dryrun_multichip():
     import __graft_entry__ as ge
-    ge.dryrun_multichip(8)
+    out = ge.dryrun_multichip(8)
+    assert out["ok"] and out["n_devices"] == 8
+    assert out["records_per_sec_sharded"] > 0
+    assert out["per_shard_records_per_sec"] is not None \
+        and len(out["per_shard_records_per_sec"]) == 8
+    assert out["scaling_efficiency"] > 0
+
+
+@pytest.mark.slow
+def test_sharded_vs_unsharded_digest_equality(tmp_path, eight_devices):
+    """The exactly-once fence contract is sharding-invariant: the same
+    job run under a 1-device mesh and an 8-device mesh seals
+    bit-identical epoch digests (``diff_ledgers`` empty)."""
+    from clonos_tpu.obs.digest import diff_ledgers
+    from clonos_tpu.parallel import distributed as dist
+    from clonos_tpu.runtime.cluster import ClusterRunner
+
+    ledgers = {}
+    for ndev in (1, 8):
+        r = ClusterRunner(_job(8), steps_per_epoch=8, log_capacity=512,
+                          max_epochs=8, inflight_ring_steps=32, seed=3,
+                          checkpoint_dir=str(tmp_path / f"m{ndev}"),
+                          audit=True, logical_time=True,
+                          mesh=dist.task_mesh(max_devices=ndev))
+        for _ in range(3):
+            r.run_epoch(complete_checkpoint=True)
+        health = r.per_shard_health()
+        assert health is not None and health.shape == (ndev, 3)
+        # Per-shard detail depends on which flats a shard owns (sink-only
+        # shards count 0 records; a completed checkpoint truncates most
+        # log rows) — assert the aggregates moved.
+        assert health[:, 0].sum() > 0 and health[:, 1].sum() > 0
+        ledgers[ndev] = r.coordinator.read_ledger()
+    assert [e["epoch"] for e in ledgers[1]] == [0, 1, 2]
+    assert diff_ledgers(ledgers[1], ledgers[8]) == []
+
+
+@pytest.mark.slow
+def test_shard_local_recovery(tmp_path, eight_devices):
+    """A failed subtask on one shard recovers by restoring/replaying only
+    that shard's slice: the report's restore bytes stay below the full
+    checkpoint, and healthy shards keep their live state untouched."""
+    from clonos_tpu.parallel import distributed as dist
+    from clonos_tpu.runtime.cluster import ClusterRunner
+    from clonos_tpu.utils.compile_cache import aot_lower_first_step
+
+    r = ClusterRunner(_job(8), steps_per_epoch=8, log_capacity=512,
+                      max_epochs=8, inflight_ring_steps=32, seed=3,
+                      checkpoint_dir=str(tmp_path / "ck"),
+                      logical_time=True,
+                      mesh=dist.task_mesh(max_devices=8))
+    r.run_epoch(complete_checkpoint=True)
+    r.run_epoch(complete_checkpoint=False)
+    # The standby's sharded first-step program AOT-lowers cleanly.
+    assert aot_lower_first_step(r.executor) is not None
+
+    before = jax.device_get(r.executor.carry)
+    failed = 8 + 2          # one window subtask = one shard's slice
+    r.inject_failure([failed])
+    report = r.recover()
+
+    assert set(report.failed_subtasks) == {failed}
+    assert len(report.managers) == 1, "only the failed slice replays"
+    assert 0 < report.restore_bytes < report.checkpoint_bytes, \
+        "per-shard restore must move less than the full carry"
+    # Healthy shards kept their live buffers: every non-failed window
+    # subtask's operator state and record count is bit-identical.
+    after = jax.device_get(r.executor.carry)
+    acc_b = np.asarray(before.op_states[1]["acc"])
+    acc_a = np.asarray(after.op_states[1]["acc"])
+    for i in range(8):
+        if i != 2:
+            np.testing.assert_array_equal(acc_a[i], acc_b[i])
+    rc_b = np.asarray(before.record_counts)
+    rc_a = np.asarray(after.record_counts)
+    healthy = [i for i in range(rc_b.shape[0]) if i != failed]
+    np.testing.assert_array_equal(rc_a[healthy], rc_b[healthy])
