@@ -38,6 +38,7 @@ JVM_BASELINE_RECORDS_PER_SEC = 1.0e6
 # block_until_ready is unreliable on tunneled backends (the r02→r03
 # "regression" was timing noise from this) — use the shared d2h sync.
 from clonos_tpu.utils.devsync import device_sync  # noqa: E402
+from clonos_tpu.soak import slo as _soak_slo  # noqa: E402
 
 PAR = 8                      # per-vertex parallelism -> 32 subtasks
 BATCH = 128                  # records per source subtask per superstep
@@ -496,7 +497,60 @@ def multichip_probe(n_devices: int = 8):
     }
 
 
-def main(jobs=None, multichip=None):
+def soak_probe(duration_s: float = 30.0):
+    """Open-loop soak probe (``bench.py --soak [SECONDS]``): every
+    other number this file prints is closed-loop — the driver pushes
+    epochs back-to-back and measures how fast they drain. This probe is
+    the open-loop counterpart: a token bucket releases load at a fixed
+    rate (``BENCH_SOAK_RATE`` records/sec) whether or not the cluster
+    keeps up, a seeded chaos schedule injects a kill cascade, a gray
+    failure, and a leader-lease loss mid-run, and latency is charged
+    from each chunk's *intended*-send instant — the
+    coordinated-omission-corrected view. The exactly-once audit ledger
+    is re-diffed against a fault-free control twin after every fault;
+    any divergence fails the probe."""
+    import tempfile
+
+    from clonos_tpu.soak import (ChaosSchedule, SLOSpec, SoakConfig,
+                                 SoakDriver, build_soak_fixture,
+                                 default_kill_targets)
+
+    rate = float(os.environ.get("BENCH_SOAK_RATE", 2000))
+    seed = int(os.environ.get("BENCH_SOAK_SEED", 11))
+    with tempfile.TemporaryDirectory() as td:
+        runner, control, election = build_soak_fixture(
+            td, rate=rate, duration_s=duration_s, seed=seed)
+        schedule = ChaosSchedule.seeded(
+            seed, duration_s, default_kill_targets(runner.job))
+        driver = SoakDriver(
+            runner, SoakConfig(rate=rate, duration_s=duration_s),
+            schedule=schedule, spec=SLOSpec(),
+            control=control, election=election)
+        v = driver.run()
+    return {
+        "metric": "soak_corrected_p99_ms",
+        "value": v["latency"]["p99_ms"],
+        "unit": "ms from intended-send (coordinated-omission-free)",
+        "pass": v["pass"],
+        "rate_target": v["rate_target"],
+        "rate_achieved": v["rate_achieved"],
+        "duration_s": v["duration_s"],
+        "latency": v["latency"],
+        "windows_breached": v["windows_breached"],
+        "worst_window": v["worst_window"],
+        "faults": v["faults"],
+        "audit": v["audit"],
+        "schedule": v["schedule"],
+        "truncated": v["truncated"],
+    }
+
+
+def main(jobs=None, multichip=None, soak=None):
+    if soak:
+        # --soak [SECONDS]: run ONLY the open-loop soak probe (one JSON
+        # line, same contract as the headline bench).
+        print(json.dumps(soak_probe(float(soak))))
+        return
     if multichip:
         # --multichip [N]: run ONLY the mesh-sharding probe (one JSON
         # line, same contract as the headline bench).
@@ -549,9 +603,15 @@ def main(jobs=None, multichip=None):
     # (total records / total wall, drill excluded) — transient tunnel
     # stalls average in rather than being cherry-picked around.
     run_s = 0.0
+    # Fence walls (global_step, monotonic_s) at each measured epoch's
+    # dispatch return: the schedule anchor coordinated-omission
+    # correction needs — a fence that blocked late makes every marker
+    # sample in its epoch late too, which the markers alone never show.
+    fence_walls = []
     t_w = time.monotonic()
     for i in range(3):                # completed epochs: logs truncate
         runner.run_epoch(complete_checkpoint=True)
+        fence_walls.append((runner.global_step, time.monotonic()))
     device_sync(runner.executor.carry)
     run_s += time.monotonic() - t_w
     # Failover drill (standby rehearsal): one full multi-class recovery
@@ -562,6 +622,7 @@ def main(jobs=None, multichip=None):
     # fill epoch there are steps to replay.)
     t_w = time.monotonic()
     runner.run_epoch(complete_checkpoint=False)
+    fence_walls.append((runner.global_step, time.monotonic()))
     device_sync(runner.executor.carry)
     run_s += time.monotonic() - t_w
     drill_s = runner.failover_drill()
@@ -569,6 +630,7 @@ def main(jobs=None, multichip=None):
     t_w = time.monotonic()
     for _ in range(FILL_EPOCHS - 1):
         runner.run_epoch(complete_checkpoint=False)
+        fence_walls.append((runner.global_step, time.monotonic()))
     device_sync(runner.executor.carry)
     run_s += time.monotonic() - t_w
     throughput = ((3 + FILL_EPOCHS) * STEPS_PER_EPOCH * PAR * BATCH
@@ -651,11 +713,23 @@ def main(jobs=None, multichip=None):
         "subtasks": job.total_subtasks(),
         "device": str(jax.devices()[0].platform),
         # Latency markers (causal-RNG scheduled, replay-stable): pipeline
-        # transit time source->sink in causal-time ms.
+        # transit time source->sink in causal-time ms. The marker number
+        # is CLOSED-LOOP: epochs are pushed back-to-back, so a fence that
+        # ran long delays every later record's send without the marker
+        # ever seeing it (coordinated omission). "corrected" re-charges
+        # each sample the queueing delay of its epoch's fence against a
+        # fixed-rate schedule anchored at the first measured fence —
+        # the open-loop view (`bench.py --soak` measures it directly).
         "latency_markers": {
             "count": runner.latency.hist.count,
             "p50_ms": runner.latency.hist.quantile(0.5),
             "p99_ms": runner.latency.hist.quantile(0.99),
+            "corrected": _soak_slo.corrected_closed_loop(
+                runner.latency.samples, fence_walls,
+                STEPS_PER_EPOCH, PAR * BATCH),
+            "note": "p50/p99 = in-pipeline dwell (closed-loop); "
+                    "corrected = dwell + fence queueing delay vs a "
+                    "fixed-rate schedule (open-loop equivalent)",
         },
     }
     # Free the headline runner's device state BEFORE the secondary
@@ -714,5 +788,10 @@ if __name__ == "__main__":
                     help="run the mesh-sharding probe over N devices "
                          "(forcing N host devices when needed) instead "
                          "of the headline bench")
+    ap.add_argument("--soak", type=float, nargs="?", const=30.0,
+                    default=None, metavar="SECONDS",
+                    help="run the open-loop soak probe (fixed-rate "
+                         "load + seeded chaos + exactly-once audit) "
+                         "instead of the headline bench")
     _a = ap.parse_args()
-    sys.exit(main(jobs=_a.jobs, multichip=_a.multichip))
+    sys.exit(main(jobs=_a.jobs, multichip=_a.multichip, soak=_a.soak))

@@ -145,7 +145,8 @@ def cmd_info(args) -> int:
 def cmd_bench(args) -> int:
     import bench
     bench.main(jobs=getattr(args, "jobs", None),
-               multichip=getattr(args, "multichip", None))
+               multichip=getattr(args, "multichip", None),
+               soak=getattr(args, "soak", None))
     return 0
 
 
@@ -224,7 +225,8 @@ def cmd_slotworker(args) -> int:
     worker = SliceWorker(
         args.executor_id, (host, int(port)), lease_path=args.lease,
         slots=args.slots, bind_host=args.bind_host,
-        heartbeat_interval=args.heartbeat_interval)
+        heartbeat_interval=args.heartbeat_interval,
+        chaos_step_delay_s=args.chaos_step_delay)
     endpoint = None
     if args.metrics_port is not None:
         from clonos_tpu.utils.metrics import (MetricRegistry,
@@ -595,6 +597,21 @@ def _top_table(snap) -> str:
                 f"{_cell(m, 'audit.epochs-validated'):>5} "
                 f"{_cell(m, 'audit.divergences'):>4} "
                 f"{_cell(m, 'audit.exactly-once-ok'):>5}")
+    # Soak status row: the open-loop driver's soak.* gauges (rate vs
+    # target, backlog, SLO breaches, fault + audit tallies). Matched by
+    # suffix too, so the row survives a worker.<eid> prefix.
+    soak = {}
+    for k, v in sorted(snap.items()):
+        if not isinstance(v, (int, float)) or isinstance(v, bool):
+            continue
+        if k.startswith("soak."):
+            soak[k[len("soak."):]] = v
+        elif ".soak." in k:
+            soak.setdefault(k.rsplit(".soak.", 1)[1], v)
+    if soak:
+        lines.append("")
+        lines.append("soak: " + "  ".join(
+            f"{k}={v}" for k, v in sorted(soak.items())))
     tenant = {k: v for k, v in sorted(snap.items())
               if (k.startswith("tenant.")
                   or k.startswith("dispatcher."))
@@ -813,6 +830,115 @@ def cmd_trace(args) -> int:
     return 0
 
 
+def cmd_soak(args) -> int:
+    """Open-loop soak run (``clonos_tpu soak``): paced load at a fixed
+    ingestion rate, a seeded (or explicit) chaos schedule, windowed SLO
+    evaluation on coordinated-omission-corrected latency, and the
+    exactly-once audit re-validated against a fault-free control twin
+    after every injected fault. Writes the full verdict to a durable
+    ``SOAK_r0N.json`` artifact and exits 0 (pass) / 1 (fail)."""
+    import os
+    import tempfile
+    from clonos_tpu.soak import (ChaosSchedule, SLOSpec, SoakConfig,
+                                 SoakDriver, build_soak_fixture,
+                                 default_kill_targets,
+                                 next_soak_artifact_path, parse_schedule)
+
+    tracer = _setup_tracer(args, "soak")
+    _setup_profile(args)
+    workdir = args.workdir or tempfile.mkdtemp(prefix="clonos-soak-")
+    runner, control, election = build_soak_fixture(
+        workdir, rate=args.rate, duration_s=args.duration,
+        steps_per_epoch=args.steps_per_epoch, par=args.parallelism,
+        batch=args.batch, seed=args.seed, audit=not args.no_audit)
+
+    if args.schedule is not None:
+        text = args.schedule
+        if os.path.exists(text):
+            with open(text) as f:
+                text = f.read()
+        schedule = parse_schedule(text)
+    else:
+        # one kill/gray candidate per vertex class (a cascade must not
+        # take every replica of one vertex with it); fire times stay
+        # inside the paced window
+        targets = default_kill_targets(runner.job)
+        schedule = ChaosSchedule.seeded(
+            args.seed, args.duration, targets,
+            kinds=tuple(args.faults.split(",")) if args.faults
+            else ("kill", "gray", "leader-loss"),
+            n_events=args.events, cascade=args.cascade)
+
+    spec = SLOSpec(max_p99_ms=args.max_p99_ms,
+                   min_throughput=args.min_throughput,
+                   max_recovery_ms=args.max_recovery_ms,
+                   exactly_once=not args.no_audit)
+    cfg = SoakConfig(rate=args.rate, duration_s=args.duration,
+                     window_s=args.window,
+                     chunk_steps=args.chunk_steps,
+                     complete_every=args.complete_every)
+    driver = SoakDriver(runner, cfg, schedule=schedule, spec=spec,
+                        control=control, election=election,
+                        records_per_step=args.parallelism * args.batch)
+
+    endpoint = None
+    if args.metrics_port is not None:
+        from clonos_tpu.utils.metrics import MetricsEndpoint
+        endpoint = MetricsEndpoint(runner.metrics,
+                                   port=args.metrics_port,
+                                   tracer=tracer,
+                                   history=_make_history(args))
+        print(f"# metrics: http://{endpoint.address[0]}:"
+              f"{endpoint.address[1]}/metrics", file=sys.stderr)
+    try:
+        verdict = driver.run()
+    finally:
+        if endpoint is not None:
+            endpoint.close()
+
+    out_path = args.out or next_soak_artifact_path()
+    with open(out_path, "w") as f:
+        json.dump(verdict, f, indent=2)
+    rc = 0 if verdict["pass"] else 1
+    if args.report == "json":
+        # CI convention: one machine-readable line, exit 0/1.
+        lat = verdict["latency"]
+        print(json.dumps({
+            "pass": verdict["pass"],
+            "rate_target": verdict["rate_target"],
+            "rate_achieved": verdict["rate_achieved"],
+            "p50_ms": lat["p50_ms"], "p99_ms": lat["p99_ms"],
+            "windows_breached": verdict["windows_breached"],
+            "faults": verdict["faults"]["injected"],
+            "survived": verdict["faults"]["survived"],
+            "exactly_once": verdict["audit"]["exactly_once"],
+            "divergences": len(verdict["audit"]["divergences"]),
+            "artifact": out_path}))
+        return rc
+    lat = verdict["latency"]
+    print(f"soak {'PASS' if verdict['pass'] else 'FAIL'}: "
+          f"{verdict['rate_achieved']:.0f}/{verdict['rate_target']:.0f} "
+          f"rec/s over {verdict['duration_s']:.1f}s")
+    print(f"latency (corrected): p50={lat['p50_ms']}ms "
+          f"p99={lat['p99_ms']}ms p99.9={lat['p999_ms']}ms "
+          f"(actual-send p99={lat['actual_send_p99_ms']}ms)")
+    f_ = verdict["faults"]
+    print(f"faults: {f_['injected']} injected, {f_['survived']} "
+          f"survived {f_['by_kind']}; recoveries "
+          f"{[round(m) for m in f_['recoveries_ms']]} ms")
+    a = verdict["audit"]
+    print(f"audit: exactly_once={a['exactly_once']} "
+          f"({a['epochs_checked']} epochs checked, "
+          f"{len(a['divergences'])} divergences)")
+    for d in a["divergences"]:
+        print(f"  divergence: {d}")
+    for w in verdict["windows"]:
+        for b in w["breaches"]:
+            print(f"  window {w['window']} breach: {b}")
+    print(f"artifact: {out_path}")
+    return rc
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="clonos_tpu")
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -847,6 +973,12 @@ def main(argv=None) -> int:
                          "devices (per-shard throughput, scaling "
                          "efficiency, sealed-digest equality vs the "
                          "1-device run)")
+    pb.add_argument("--soak", type=float, nargs="?", const=30.0,
+                    default=None, metavar="SECONDS",
+                    help="run ONLY the open-loop soak probe: paced "
+                         "fixed-rate load + seeded chaos + exactly-"
+                         "once audit (see `clonos_tpu soak` for the "
+                         "full-control version)")
     pb.set_defaults(fn=cmd_bench)
 
     pd = sub.add_parser("dryrun", help="multichip sharding dry run")
@@ -905,6 +1037,13 @@ def main(argv=None) -> int:
     ps.add_argument("--epoch-sleep", type=float, default=0.0,
                     help="pause after each served epoch round (lets "
                          "tests kill mid-run)")
+    ps.add_argument("--chaos-step-delay", type=float, default=0.0,
+                    metavar="SECONDS",
+                    help="gray-failure injection: sleep this long "
+                         "before each slice epoch — degraded (late "
+                         "fences) but never dead (heartbeats keep "
+                         "flowing); the soak/chaos harness's "
+                         "multi-process slow-worker surface")
     ps.add_argument("--metrics-port", type=int, default=None,
                     help="serve this worker's /metrics + /metrics.json "
                          "+ /trace on this port (0 = ephemeral)")
@@ -1030,6 +1169,72 @@ def main(argv=None) -> int:
                          "line {match, groups, problems}; exit code "
                          "stays 0 on match / 1 on divergence")
     pa.set_defaults(fn=cmd_audit)
+
+    pk = sub.add_parser("soak", help="open-loop soak: fixed-rate load "
+                                     "+ chaos schedule + SLO windows + "
+                                     "exactly-once audit under fault")
+    pk.add_argument("--rate", type=float, default=2000.0,
+                    help="ingestion rate the token bucket sustains "
+                         "(records/sec); chunks falling behind are "
+                         "charged from their intended-send instant")
+    pk.add_argument("--duration", type=float, default=60.0,
+                    help="paced-phase length (seconds of soak clock)")
+    pk.add_argument("--window", type=float, default=5.0,
+                    help="SLO evaluation window width (seconds)")
+    pk.add_argument("--seed", type=int, default=11,
+                    help="seeds BOTH the job and the generated chaos "
+                         "schedule — same seed, same run, bit for bit")
+    pk.add_argument("--schedule", default=None, metavar="DSL|FILE",
+                    help="explicit chaos schedule: DSL text (';'-"
+                         "separated) or a path to a schedule file; "
+                         "overrides the seeded generator")
+    pk.add_argument("--faults", default=None,
+                    metavar="KIND[,KIND...]",
+                    help="fault kinds for the seeded generator "
+                         "(default kill,gray,leader-loss; add nondet "
+                         "to prove the audit catches an unlogged "
+                         "perturbation — that run MUST exit 1)")
+    pk.add_argument("--events", type=int, default=None,
+                    help="events in the seeded schedule (default: one "
+                         "per kind)")
+    pk.add_argument("--cascade", type=int, default=3,
+                    help="subtasks per cascading kill")
+    pk.add_argument("--max-p99-ms", type=float, default=None,
+                    help="SLO: per-window corrected p99 bound")
+    pk.add_argument("--min-throughput", type=float, default=None,
+                    help="SLO: per-window records/sec floor")
+    pk.add_argument("--max-recovery-ms", type=float, default=None,
+                    help="SLO: bound on any single recovery/pause")
+    pk.add_argument("--no-audit", action="store_true",
+                    help="skip the control twin + exactly-once "
+                         "re-validation (halves the compute; the "
+                         "verdict then rests on SLO windows alone)")
+    pk.add_argument("--steps-per-epoch", type=int, default=64)
+    pk.add_argument("--parallelism", type=int, default=2)
+    pk.add_argument("--batch", type=int, default=8)
+    pk.add_argument("--chunk-steps", type=int, default=8,
+                    help="supersteps per token-bucket release")
+    pk.add_argument("--complete-every", type=int, default=2,
+                    help="complete every Nth checkpoint (in-between "
+                         "fences stay pending: checkpoint-under-load)")
+    pk.add_argument("--workdir", default=None,
+                    help="checkpoint/lease dir (default: a fresh "
+                         "tempdir)")
+    pk.add_argument("--out", default=None, metavar="FILE",
+                    help="verdict artifact path (default: next free "
+                         "SOAK_r0N.json in the cwd)")
+    pk.add_argument("--report", choices=["json"], default=None,
+                    help="machine-readable summary for CI: one JSON "
+                         "line; exit 0 pass / 1 fail either way")
+    pk.add_argument("--metrics-port", type=int, default=None,
+                    help="serve /metrics + /metrics.json with the "
+                         "soak.* gauges while running (0 = ephemeral; "
+                         "point `clonos_tpu top` here)")
+    pk.add_argument("--trace-dir", default=None,
+                    help="record soak/chaos/breach trace spans to "
+                         "trace-soak.jsonl here")
+    _add_profile_args(pk)
+    pk.set_defaults(fn=cmd_soak)
 
     pl = sub.add_parser("lint", help="static determinism lint of "
                                      "pipeline and runtime code")

@@ -67,6 +67,10 @@ class MetricsHistory:
         self.window = int(window)
         self._clock = clock
         self._ring: Deque[dict] = collections.deque(maxlen=self.window)
+        #: sampling slots skipped because a sample overran its whole
+        #: interval (the loop re-anchors instead of bursting catch-up
+        #: samples with bogus spacing)
+        self.missed_slots = 0
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -117,8 +121,22 @@ class MetricsHistory:
         self._file_lines = len(self._ring)
 
     def _loop(self) -> None:
-        while not self._stop.wait(self.interval_s):
+        # Absolute-deadline pacing: ``wait(interval)`` THEN sample would
+        # stretch every period by the sample's own duration (a slow
+        # cluster-view merge under load turns a 2s interval into 3s+,
+        # silently squeezing the ring's time span). Each deadline is
+        # interval_s after the previous DEADLINE, not after the sample
+        # finished; a sample that overruns whole intervals skips the
+        # missed slots (counted) rather than firing a catch-up burst.
+        next_due = time.monotonic() + self.interval_s
+        while not self._stop.wait(max(next_due - time.monotonic(), 0.0)):
             self.sample_once()
+            next_due += self.interval_s
+            now = time.monotonic()
+            if next_due <= now:
+                missed = int((now - next_due) / self.interval_s) + 1
+                self.missed_slots += missed
+                next_due += missed * self.interval_s
 
     def start(self) -> "MetricsHistory":
         if self._thread is None:

@@ -409,6 +409,23 @@ class CheckpointCoordinator:
             missing.intersection_update(except_subtasks)
             self._maybe_complete(checkpoint_id)
 
+    def discard_pending_through(self, checkpoint_id: int) -> List[int]:
+        """Abandon every pending checkpoint at or below
+        ``checkpoint_id``: they can never complete (their fence has been
+        superseded by a newer completed one, so completing them late
+        would regress every completion listener — standby refresh, ring
+        truncation). The soak driver's pre-kill barrier: a fence that
+        leaves ZERO pending checkpoints means a kill in the next epoch
+        recovers without ignoring anything, so no IGNORE_CHECKPOINT
+        determinants land in healthy logs and the digest chain stays
+        byte-comparable with a fault-free control run. Returns the
+        abandoned ids."""
+        cids = sorted(c for c in self._pending if c <= checkpoint_id)
+        for cid in cids:
+            self._ignored.add(cid)
+            del self._pending[cid]
+        return cids
+
     def _maybe_complete(self, checkpoint_id: int) -> None:
         missing = self._pending.get(checkpoint_id)
         if missing:

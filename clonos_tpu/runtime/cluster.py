@@ -63,16 +63,23 @@ class HeartbeatMonitor:
         self.timeout_s = timeout_s
         self._last: Dict[int, float] = {s: clock() for s in subtasks}
         self._dead: Set[int] = set()
+        #: injected per-subtask heartbeat delay (seconds): a gray-failed
+        #: worker's beats ARRIVE this much late — the worker is alive and
+        #: making (slow) progress, so the monitor must classify it as
+        #: degraded, not dead. Written by the chaos injector
+        #: (soak/driver.py); empty in production.
+        self.lag: Dict[int, float] = {}
 
     def beat(self, subtask: int) -> None:
         if subtask not in self._dead:
-            self._last[subtask] = self._clock()
+            self._last[subtask] = (self._clock()
+                                   - self.lag.get(subtask, 0.0))
 
     def beat_all_except(self, dead: Set[int]) -> None:
         now = self._clock()
         for s in self._last:
             if s not in dead and s not in self._dead:
-                self._last[s] = now
+                self._last[s] = now - self.lag.get(s, 0.0)
 
     def mark_dead(self, subtask: int) -> None:
         self._dead.add(subtask)
@@ -83,8 +90,27 @@ class HeartbeatMonitor:
                if s not in self._dead and now - t > self.timeout_s]
         return sorted(out)
 
+    def degraded(self, grace_s: float = 0.0) -> List[int]:
+        """Subtasks whose beats arrive late but inside the death
+        timeout: gray failures. Lateness is measured against the
+        FRESHEST live beat, not wall time — between beat rounds every
+        worker's last beat ages identically, and only a worker lagging
+        its peers by more than ``grace_s`` is actually degraded.
+        Disjoint from :meth:`expired` by construction — a worker is
+        degraded OR dead, never both."""
+        alive = {s: t for s, t in self._last.items()
+                 if s not in self._dead}
+        if not alive:
+            return []
+        freshest = max(alive.values())
+        now = self._clock()
+        out = [s for s, t in alive.items()
+               if freshest - t > grace_s and now - t <= self.timeout_s]
+        return sorted(out)
+
     def revive(self, subtask: int) -> None:
         self._dead.discard(subtask)
+        self.lag.pop(subtask, None)
         self._last[subtask] = self._clock()
 
 
@@ -99,7 +125,12 @@ class StandbyPool:
         self.dispatch_count = 0
 
     def on_completed_checkpoint(self, ckpt: cp.CompletedCheckpoint) -> None:
-        self.latest = ckpt
+        # Monotonic: async writes can complete out of order, and a
+        # stale completion must never regress the restore point behind
+        # state (ring truncation) that has already moved past it.
+        if self.latest is None \
+                or ckpt.checkpoint_id >= self.latest.checkpoint_id:
+            self.latest = ckpt
         self.dispatch_count += 1
 
     def has_state(self) -> bool:
@@ -135,6 +166,13 @@ class LatencyMarkers:
         self.hist = runner.metrics.group(
             f"job.{job.name}").histogram("latency-ms")
         self._seen = 0
+        #: recent ``(source step, latency)`` pairs behind the histogram —
+        #: the raw series coordinated-omission correction needs (the
+        #: histogram forgets WHEN a sample happened, so queueing delay
+        #: can't be re-attributed from it). Bounded: keeps the newest
+        #: ``max_samples``.
+        self.samples: List[Tuple[int, float]] = []
+        self.max_samples = 8192
 
     @staticmethod
     def schedule(rngs, every: int):
@@ -148,7 +186,11 @@ class LatencyMarkers:
         for s in range(self._seen, max(upto, 0)):
             t, r = hist[s]
             if r % self.every == 0:
-                self.hist.update(hist[s + self.depth][0] - t)
+                lat = hist[s + self.depth][0] - t
+                self.hist.update(lat)
+                self.samples.append((s, float(lat)))
+        if len(self.samples) > self.max_samples:
+            del self.samples[:len(self.samples) - self.max_samples]
         self._seen = max(self._seen, upto, 0)
 
 

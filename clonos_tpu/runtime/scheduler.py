@@ -532,9 +532,17 @@ class SliceWorker:
     def __init__(self, executor_id: str, jm_address: Tuple[str, int],
                  lease_path: Optional[str] = None, slots: int = 1,
                  bind_host: str = "127.0.0.1",
-                 heartbeat_interval: float = 0.5, emit=None):
+                 heartbeat_interval: float = 0.5, emit=None,
+                 chaos_step_delay_s: float = 0.0):
         self.executor_id = executor_id
         self.bind_host = bind_host
+        #: gray-failure injection surface for the soak/chaos harness
+        #: (``clonos_tpu slotworker --chaos-step-delay``): every epoch
+        #: round sleeps this long FIRST, so the worker is degraded — its
+        #: fences run late and co-hosted tenants see the slowdown — but
+        #: never dead: heartbeats keep flowing and the JobMaster must
+        #: classify it via HeartbeatMonitor.degraded(), not expired().
+        self.chaos_step_delay_s = float(chaos_step_delay_s)
         self.endpoint = TaskExecutorEndpoint(lease_path, bind_host)
         self._jm = tp.ControlClient(tuple(jm_address))
         # Heartbeats piggyback the worker's last metric snapshot so the
@@ -737,6 +745,8 @@ class SliceWorker:
                     self._emit(status)
                 continue
             closed = sl.runner.executor.epoch_id
+            if self.chaos_step_delay_s:
+                time.sleep(self.chaos_step_delay_s)
             sl.runner.run_epoch(
                 complete_checkpoint=(closed % sl.complete_every == 0))
             # Status BEFORE the refresh (see class docstring).

@@ -1,0 +1,232 @@
+"""Chaos schedule DSL: a seeded, replayable fault timetable.
+
+The schedule is pure data — WHAT to break and WHEN, decoupled from HOW
+(the :class:`soak.driver.SoakHarness` applies events to a live
+cluster). That split is what makes runs replayable: the same schedule
+text (or the same ``seed``) produces the identical fault sequence, so a
+soak that tripped the audit can be re-run bit-for-bit.
+
+Grammar (one event per line or ``;``-separated; ``#`` comments)::
+
+    at 5s kill 1,9,17                 # cascading SIGKILL: flat subtasks
+    at 12s gray 2 delay=50ms for 3s   # slow-worker gray failure
+    at 20s leader-loss hold=1s        # rival claims the lease for 1s
+    at 30s stall delay=200ms for 2s   # checkpoint-storage write stall
+    at 40s nondet                     # unlogged value perturbation
+                                      # (audit bait — MUST fail the run)
+
+Durations accept ``ms``/``s`` suffixes (bare numbers are seconds).
+``ChaosSchedule.seeded`` generates a schedule from a seed via a seeded
+``np.random.RandomState`` — deterministic by construction, covering
+every requested fault kind at least once.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+#: every fault kind the harness knows how to apply. ``nondet`` is the
+#: audit bait: an unlogged perturbation that every structural check
+#: passes and only the epoch-digest diff catches.
+FAULT_KINDS = ("kill", "gray", "leader-loss", "stall", "nondet")
+
+
+def _dur(tok: str) -> float:
+    """Parse a duration token: ``200ms`` / ``1.5s`` / ``3`` (seconds)."""
+    tok = tok.strip()
+    try:
+        if tok.endswith("ms"):
+            return float(tok[:-2]) / 1e3
+        if tok.endswith("s"):
+            return float(tok[:-1])
+        return float(tok)
+    except ValueError:
+        raise ValueError(f"bad duration {tok!r} (want e.g. 200ms, 1.5s)")
+
+
+def _fmt_dur(s: float) -> str:
+    if s < 1.0:
+        return f"{s * 1e3:g}ms"
+    return f"{s:g}s"
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosEvent:
+    """One fault at one instant of the soak clock (seconds from the
+    start of the paced phase)."""
+
+    at_s: float
+    kind: str
+    #: flat subtask ids (kill: the cascade; gray: the slow worker)
+    targets: Tuple[int, ...] = ()
+    #: gray: injected heartbeat/transport delay; stall: per-write delay
+    delay_s: float = 0.0
+    #: gray/stall: how long the degradation stays active
+    duration_s: float = 0.0
+    #: leader-loss: how long the rival holds the stolen lease
+    hold_s: float = 0.0
+
+    def to_text(self) -> str:
+        parts = [f"at {_fmt_dur(self.at_s)}", self.kind]
+        if self.targets:
+            parts.append(",".join(str(t) for t in self.targets))
+        if self.kind in ("gray", "stall"):
+            parts.append(f"delay={_fmt_dur(self.delay_s)}")
+            parts.append(f"for {_fmt_dur(self.duration_s)}")
+        if self.kind == "leader-loss" and self.hold_s:
+            parts.append(f"hold={_fmt_dur(self.hold_s)}")
+        return " ".join(parts)
+
+
+def _parse_event(line: str) -> ChaosEvent:
+    toks = line.split()
+    if len(toks) < 3 or toks[0] != "at":
+        raise ValueError(f"chaos event {line!r}: want 'at <time> <kind> "
+                         f"[args]'")
+    at_s = _dur(toks[1])
+    kind = toks[2]
+    if kind not in FAULT_KINDS:
+        raise ValueError(f"chaos event {line!r}: unknown kind {kind!r} "
+                         f"(one of {', '.join(FAULT_KINDS)})")
+    targets: Tuple[int, ...] = ()
+    delay_s = 0.0
+    duration_s = 0.0
+    hold_s = 0.0
+    i = 3
+    if kind in ("kill", "gray"):
+        if i >= len(toks):
+            raise ValueError(f"chaos event {line!r}: {kind} needs "
+                             f"target subtask(s)")
+        try:
+            targets = tuple(int(t) for t in toks[i].split(",") if t)
+        except ValueError:
+            raise ValueError(f"chaos event {line!r}: bad targets "
+                             f"{toks[i]!r}")
+        if not targets:
+            raise ValueError(f"chaos event {line!r}: empty target list")
+        i += 1
+    while i < len(toks):
+        tok = toks[i]
+        if tok.startswith("delay="):
+            delay_s = _dur(tok[len("delay="):])
+        elif tok.startswith("hold="):
+            hold_s = _dur(tok[len("hold="):])
+        elif tok == "for":
+            i += 1
+            if i >= len(toks):
+                raise ValueError(f"chaos event {line!r}: 'for' needs a "
+                                 f"duration")
+            duration_s = _dur(toks[i])
+        else:
+            raise ValueError(f"chaos event {line!r}: unexpected token "
+                             f"{tok!r}")
+        i += 1
+    if kind in ("gray", "stall") and (delay_s <= 0 or duration_s <= 0):
+        raise ValueError(f"chaos event {line!r}: {kind} needs "
+                         f"delay=<d> for <d>")
+    if kind == "gray" and len(targets) != 1:
+        raise ValueError(f"chaos event {line!r}: gray takes exactly one "
+                         f"target")
+    return ChaosEvent(at_s=at_s, kind=kind, targets=targets,
+                      delay_s=delay_s, duration_s=duration_s,
+                      hold_s=hold_s)
+
+
+def parse_schedule(text: str) -> "ChaosSchedule":
+    """Parse DSL text into a schedule (events sorted by fire time)."""
+    events = []
+    for raw in text.replace(";", "\n").splitlines():
+        line = raw.split("#", 1)[0].strip()
+        if not line:
+            continue
+        events.append(_parse_event(line))
+    return ChaosSchedule(events)
+
+
+class ChaosSchedule:
+    """An ordered fault timetable. Immutable once built; the driver
+    keeps its own cursor, so one schedule can drive many runs."""
+
+    def __init__(self, events: Sequence[ChaosEvent]):
+        self.events: List[ChaosEvent] = sorted(events,
+                                               key=lambda e: e.at_s)
+
+    def __len__(self) -> int:
+        return len(self.events)
+
+    def __iter__(self):
+        return iter(self.events)
+
+    def __eq__(self, other) -> bool:
+        return (isinstance(other, ChaosSchedule)
+                and self.events == other.events)
+
+    def kinds(self) -> List[str]:
+        return [e.kind for e in self.events]
+
+    def to_text(self) -> str:
+        return "\n".join(e.to_text() for e in self.events)
+
+    @classmethod
+    def seeded(cls, seed: int, duration_s: float,
+               targets: Sequence[int],
+               kinds: Sequence[str] = ("kill", "gray", "leader-loss"),
+               n_events: Optional[int] = None,
+               cascade: int = 3) -> "ChaosSchedule":
+        """Generate a replayable schedule: same ``seed`` (and the same
+        other arguments) → the same fault sequence, byte for byte.
+
+        Fire times land in the middle ``[0.2, 0.85] * duration_s`` band
+        so the paced warm-in and the final seal/audit window stay
+        fault-free. Every requested kind appears at least once
+        (``n_events`` defaults to ``len(kinds)``); extra events draw
+        kinds uniformly. Kill cascades pick ``cascade`` distinct flat
+        subtasks from ``targets`` — the config4 "connected failures"
+        pattern when the caller passes one subtask per vertex class.
+        """
+        for k in kinds:
+            if k not in FAULT_KINDS:
+                raise ValueError(f"unknown fault kind {k!r}")
+        if not targets and any(k in ("kill", "gray") for k in kinds):
+            raise ValueError("kill/gray faults need candidate targets")
+        n = max(n_events or len(kinds), len(kinds))
+        rng = np.random.RandomState(seed)
+        times = np.sort(rng.uniform(0.2 * duration_s, 0.85 * duration_s,
+                                    size=n))
+        # Coverage first, then uniform draws — order shuffled so the
+        # guaranteed instances are not always the earliest events.
+        picked = list(kinds) + [kinds[int(rng.randint(len(kinds)))]
+                                for _ in range(n - len(kinds))]
+        rng.shuffle(picked)
+        events = []
+        for at_s, kind in zip(times, picked):
+            # ms precision: to_text() must round-trip byte-for-byte
+            at_s = round(float(at_s), 3)
+            if kind == "kill":
+                k = min(cascade, len(targets))
+                tg = tuple(int(t) for t in sorted(
+                    rng.choice(np.asarray(targets), size=k,
+                               replace=False)))
+                events.append(ChaosEvent(float(at_s), "kill", targets=tg))
+            elif kind == "gray":
+                tg = (int(np.asarray(targets)[
+                    int(rng.randint(len(targets)))]),)
+                events.append(ChaosEvent(
+                    float(at_s), "gray", targets=tg,
+                    delay_s=round(float(rng.uniform(0.02, 0.08)), 3),
+                    duration_s=round(float(rng.uniform(2.0, 4.0)), 2)))
+            elif kind == "leader-loss":
+                events.append(ChaosEvent(
+                    float(at_s), "leader-loss",
+                    hold_s=round(float(rng.uniform(0.4, 0.9)), 2)))
+            elif kind == "stall":
+                events.append(ChaosEvent(
+                    float(at_s), "stall",
+                    delay_s=round(float(rng.uniform(0.1, 0.3)), 3),
+                    duration_s=round(float(rng.uniform(1.0, 3.0)), 2)))
+            else:                       # nondet
+                events.append(ChaosEvent(float(at_s), "nondet"))
+        return cls(events)

@@ -1,0 +1,574 @@
+"""Open-loop soak driver: fixed-rate load + chaos + exactly-once audit.
+
+The driver paces a live :class:`ClusterRunner` with a token bucket: a
+chunk of supersteps is DUE at a fixed schedule (``rate`` records/sec),
+regardless of whether the cluster is keeping up. End-to-end latency is
+measured from the chunk's *intended*-send instant — a chunk that runs
+while the driver is busy recovering a kill is charged the whole stall,
+which is what an open-loop client would have experienced (the
+coordinated-omission correction the closed-loop bench numbers lack).
+
+The chaos harness applies :class:`soak.chaos.ChaosEvent` faults to the
+running cluster and, after every event, re-validates the audit ledger
+against a fault-free **control twin**: a second runner of the same job,
+same seed, logical time on both, advanced epoch-by-epoch to the soak
+runner's last sealed epoch. Any digest divergence is an exactly-once
+violation and fails the run — the Jepsen-style check the Clonos
+reference delegates to flink-jepsen.
+
+Kill scheduling detail: a kill is applied only in the epoch after a
+*completing* fence (the driver forces one when a kill is due). With no
+pending checkpoints, recovery ignores nothing, so the healthy tasks log
+no IGNORE_CHECKPOINT determinants and the post-recovery digest chain
+stays byte-comparable with the control twin — the audit asserts the
+recovery itself was exactly-once, not merely that the run finished.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time as _time
+from typing import Any, Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from clonos_tpu.obs import get_tracer
+from clonos_tpu.obs.digest import diff_ledgers
+
+from .chaos import ChaosEvent, ChaosSchedule
+from .slo import SLOSpec, SLOTracker, quantile
+
+#: multiplicative salt for the injected nondeterminism fault — the
+#: examples/audit_nondet.py pattern: perturb ring VALUES only (keys,
+#: counts, and ordering stay plausible) so every structural invariant
+#: passes and only the digest chain catches it.
+_NONDET_MULT, _NONDET_ADD, _NONDET_MOD = 31, 1009, 9973
+
+
+class SoakHarness:
+    """Applies chaos events to a live runner and owns the post-event
+    audit re-validation against the fault-free control twin."""
+
+    def __init__(self, runner, control=None, election=None, tracer=None):
+        self.runner = runner
+        self.control = control
+        self.election = election
+        self.tracer = tracer or get_tracer()
+        #: flat subtask -> soak-clock instant its gray failure expires
+        self.gray_until: Dict[int, float] = {}
+        #: current per-chunk transport slowdown from active gray faults
+        self.gray_delay_s = 0.0
+        self._stall_orig = None
+        self._stall_until = 0.0
+        #: set on every applied fault; the driver runs an audit check at
+        #: the next fence and clears it
+        self.audit_pending = False
+        self.divergences: List[str] = []
+        self.epochs_checked = 0
+        self.faults_injected = 0
+        self.faults_survived = 0
+        self.by_kind: Dict[str, int] = {}
+        self.recoveries_ms: List[float] = []
+
+    # --- fault application ---------------------------------------------------
+
+    def apply(self, event: ChaosEvent, now_s: float) -> None:
+        """Apply one fault NOW (``now_s`` is the soak clock, for expiry
+        bookkeeping + the trace instant)."""
+        self.tracer.event("soak.chaos", kind=event.kind,
+                          at_s=round(now_s, 3),
+                          targets=list(event.targets))
+        self.faults_injected += 1
+        self.by_kind[event.kind] = self.by_kind.get(event.kind, 0) + 1
+        getattr(self, "_apply_" + event.kind.replace("-", "_"))(
+            event, now_s)
+        self.audit_pending = True
+
+    def _apply_kill(self, event: ChaosEvent, now_s: float) -> None:
+        # Cascading SIGKILL mid-epoch (the config4 pattern when the
+        # schedule targets one subtask per vertex class), then the full
+        # causal-recovery protocol inline — the pacer keeps charging
+        # intended-send time throughout, so the outage lands in p99.
+        r = self.runner
+        r.inject_failure(list(event.targets))
+        t0 = _time.monotonic()
+        r.recover()
+        ms = (_time.monotonic() - t0) * 1e3
+        self.recoveries_ms.append(ms)
+        self.faults_survived += 1
+        self.tracer.event("soak.chaos.recovered", kind="kill",
+                          targets=list(event.targets),
+                          recovery_ms=round(ms, 1))
+
+    def _apply_gray(self, event: ChaosEvent, now_s: float) -> None:
+        # Degraded, not dead: the worker's heartbeats arrive late and
+        # its transport stretches every chunk, but it keeps stepping.
+        # The monitor must report it in degraded(), never in expired().
+        flat = event.targets[0]
+        self.runner.heartbeats.lag[flat] = event.delay_s
+        self.gray_until[flat] = now_s + event.duration_s
+        self.gray_delay_s = max(self.gray_delay_s, event.delay_s)
+
+    def _apply_leader_loss(self, event: ChaosEvent, now_s: float) -> None:
+        # A rival claims the next fencing epoch: our renew() becomes a
+        # no-op for every reader and returns False. The driver pauses
+        # ingestion while deposed and re-acquires once the rival's
+        # deadline lapses (hold_s).
+        el = self.election
+        if el is None:
+            return
+        import json as _json
+        epoch = (el.epoch or max(el._claims() or [0])) + 1
+        tmp = el._claim_path(epoch) + ".chaos.tmp"
+        with open(tmp, "w") as f:
+            _json.dump({"leader_id": "chaos-rival",
+                        "deadline_wall": el._clock() + event.hold_s}, f)
+        os.replace(tmp, el._claim_path(epoch))
+
+    def _apply_stall(self, event: ChaosEvent, now_s: float) -> None:
+        # Checkpoint-storage write stall: every durable write sleeps
+        # delay_s for the fault's duration. run_epoch triggers with
+        # async_write=False, so the stall lands squarely in fence
+        # latency (and therefore in the corrected latency of the chunks
+        # queued behind it).
+        storage = self.runner.coordinator.storage
+        if self._stall_orig is None:
+            self._stall_orig = storage.write
+        orig, delay = self._stall_orig, event.delay_s
+
+        def stalled_write(*a, **k):
+            _time.sleep(delay)
+            return orig(*a, **k)
+
+        storage.write = stalled_write
+        self._stall_until = max(self._stall_until,
+                                now_s + event.duration_s)
+
+    def _apply_nondet(self, event: ChaosEvent, now_s: float) -> None:
+        # Unlogged value perturbation on-device (audit bait): occupied
+        # in-flight ring slots get salted values. Counts, keys, and
+        # timestamps stay exactly right — the next seal's ring-channel
+        # digest is the only thing that can catch this.
+        ex = self.runner.executor
+        rings = tuple(
+            el._replace(values=jnp.where(
+                el.valid,
+                (el.values * _NONDET_MULT + _NONDET_ADD) % _NONDET_MOD,
+                el.values))
+            for el in ex.carry.out_rings)
+        ex.carry = ex.carry._replace(out_rings=rings)
+
+    # --- expiry + audit ------------------------------------------------------
+
+    def tick(self, now_s: float) -> None:
+        """Expire time-bounded degradations (gray, stall)."""
+        for flat, until in list(self.gray_until.items()):
+            if now_s >= until:
+                del self.gray_until[flat]
+                self.runner.heartbeats.lag.pop(flat, None)
+                self.faults_survived += 1
+                self.tracer.event("soak.chaos.expired", kind="gray",
+                                  target=flat)
+        if not self.gray_until:
+            self.gray_delay_s = 0.0
+        if self._stall_orig is not None and now_s >= self._stall_until:
+            self.runner.coordinator.storage.write = self._stall_orig
+            self._stall_orig = None
+            self.faults_survived += 1
+            self.tracer.event("soak.chaos.expired", kind="stall")
+
+    def audit_check(self) -> List[str]:
+        """Advance the control twin to the soak runner's last sealed
+        epoch and diff the two ledgers. Divergences accumulate; any at
+        run end means exactly-once did NOT hold."""
+        r, c = self.runner, self.control
+        if c is None or not r.auditor.enabled:
+            return []
+        while c.auditor.last_epoch < r.auditor.last_epoch:
+            c.run_epoch(complete_checkpoint=True)
+        hi = r.auditor.last_epoch
+        expected = [e for e in c.auditor.ledger() if e["epoch"] <= hi]
+        actual = [e for e in r.auditor.ledger() if e["epoch"] <= hi]
+        problems = diff_ledgers(expected, actual)
+        self.epochs_checked = max(self.epochs_checked, len(actual))
+        for p in problems:
+            if p not in self.divergences:
+                self.divergences.append(p)
+                self.tracer.event("soak.audit.divergence", problem=p)
+        return problems
+
+
+@dataclasses.dataclass
+class SoakConfig:
+    """Pacing + cadence knobs for one soak run."""
+
+    rate: float                  # records/sec the token bucket releases
+    duration_s: float = 60.0
+    window_s: float = 5.0        # SLO evaluation window
+    chunk_steps: int = 8         # supersteps released per token
+    #: complete every Nth checkpoint: the in-between fences leave their
+    #: checkpoints pending, so the in-flight rings grow across epochs —
+    #: checkpoint-under-load and the spill regime stay engaged.
+    complete_every: int = 2
+    #: beats later than this (but inside the death timeout) classify a
+    #: worker as degraded
+    degraded_grace_s: float = 0.01
+    #: renew the leader lease at most this often
+    renew_every_s: float = 0.5
+
+
+class SoakDriver:
+    """Runs the paced loop: token-bucket ingestion, chaos events on the
+    soak clock, SLO windows, and a JSON verdict."""
+
+    def __init__(self, runner, config: SoakConfig,
+                 schedule: Optional[ChaosSchedule] = None,
+                 spec: Optional[SLOSpec] = None,
+                 control=None, election=None,
+                 records_per_step: Optional[int] = None):
+        self.runner = runner
+        self.cfg = config
+        self.schedule = schedule if schedule is not None \
+            else ChaosSchedule([])
+        self.spec = spec or SLOSpec()
+        self.tracer = get_tracer()
+        self.harness = SoakHarness(runner, control=control,
+                                   election=election,
+                                   tracer=self.tracer)
+        self.slo = SLOTracker(self.spec, window_s=config.window_s,
+                              tracer=self.tracer)
+        self.records_per_step = records_per_step
+        self._rate_now = 0.0
+        self._backlog_chunks = 0
+        self._truncated = False
+        self._register_gauges()
+
+    def _register_gauges(self) -> None:
+        g = self.runner.metrics.group("soak")
+        cfg, h, slo = self.cfg, self.harness, self.slo
+        g.gauge("target-rate", lambda: cfg.rate)
+        g.gauge("rate", lambda: round(self._rate_now, 1))
+        g.gauge("backlog-chunks", lambda: self._backlog_chunks)
+        g.gauge("windows-breached",
+                lambda: len(slo.breached_windows()))
+        g.gauge("faults-injected", lambda: h.faults_injected)
+        g.gauge("faults-survived", lambda: h.faults_survived)
+        g.gauge("p99-ms", lambda: round(quantile(
+            (slo.closed[-1].corrected_ms if slo.closed
+             else slo.current.corrected_ms), 0.99), 3))
+        g.gauge("audit-ok", lambda: int(not h.divergences))
+        g.gauge("degraded-workers", lambda: len(
+            self.runner.heartbeats.degraded(cfg.degraded_grace_s)))
+
+    # --- leadership gate -----------------------------------------------------
+
+    def _leadership_gate(self, soak_now: float) -> None:
+        el = self.harness.election
+        if el is None:
+            return
+        if soak_now < getattr(self, "_next_renew_s", 0.0):
+            return
+        self._next_renew_s = soak_now + self.cfg.renew_every_s
+        if el.renew():
+            return
+        # Deposed: ingestion pauses (split-brain structurally excluded —
+        # a non-leader never fences deployments) while records keep
+        # queueing on the intended schedule; the pause is an outage the
+        # corrected latency and max_recovery_ms both see.
+        self.tracer.event("soak.leader.lost")
+        t0 = _time.monotonic()
+        while not el.try_acquire():
+            _time.sleep(0.02)
+        ms = (_time.monotonic() - t0) * 1e3
+        self.harness.recoveries_ms.append(ms)
+        self.harness.faults_survived += 1
+        self.slo.observe_recovery(soak_now, ms)
+        self.tracer.event("soak.leader.reacquired",
+                          pause_ms=round(ms, 1))
+
+    # --- the paced loop ------------------------------------------------------
+
+    def run(self) -> Dict[str, Any]:
+        cfg, r, h = self.cfg, self.runner, self.harness
+        ex = r.executor
+        spe = ex.steps_per_epoch
+        if spe % cfg.chunk_steps:
+            raise ValueError(
+                f"steps_per_epoch {spe} must be a multiple of "
+                f"chunk_steps {cfg.chunk_steps}")
+        max_epochs = ex.compiled.max_epochs
+        with self.tracer.span("soak", rate=cfg.rate,
+                              duration_s=cfg.duration_s,
+                              events=len(self.schedule)):
+            verdict = self._run_paced(cfg, r, h, ex, spe, max_epochs)
+        return verdict
+
+    def _run_paced(self, cfg, r, h, ex, spe, max_epochs):
+        # Warmup epoch 0 via run_epoch (staged program + restore point),
+        # epoch 1 via step() chunks (the K=1 live program the paced loop
+        # uses compiles here, off the measured clock).
+        r.run_epoch(complete_checkpoint=True)
+        for _ in range(spe):
+            r.step()
+        r.run_epoch(complete_checkpoint=True)   # fence-only: 0 steps left
+        # deployed-standby analog: recovery programs compile off the
+        # paced clock, so the first kill measures the protocol
+        r.prewarm_recovery()
+        if self.records_per_step is None:
+            self.records_per_step = max(
+                1, r._last_records_total // max(r.global_step, 1))
+        rps = self.records_per_step
+        chunk_records = cfg.chunk_steps * rps
+        period_s = chunk_records / cfg.rate
+        events = list(self.schedule)
+        ei = 0
+        due: List[ChaosEvent] = []
+        pending_kills: List[ChaosEvent] = []
+        kill_armed = False       # last fence completed; no pendings
+        force_complete = False
+        fences = 0
+        sent_chunks = 0
+        sent_records = 0
+        t0 = _time.monotonic()
+
+        while True:
+            intended_s = sent_chunks * period_s
+            if intended_s >= cfg.duration_s:
+                break
+            if ex.epoch_id >= max_epochs - 2:
+                self._truncated = True
+                self.tracer.event("soak.truncated",
+                                  epoch=ex.epoch_id)
+                break
+            now_s = _time.monotonic() - t0
+            if now_s < intended_s:
+                _time.sleep(intended_s - now_s)
+                now_s = intended_s
+            self._backlog_chunks = max(
+                0, int((now_s - intended_s) / period_s))
+            # -- due chaos events (soak clock): collected here, applied
+            # AFTER the chunk — every fault lands mid-epoch, with this
+            # epoch's window already holding live causal state for the
+            # perturbation (nondet) or replay span (kill) to hit.
+            while ei < len(events) and events[ei].at_s <= now_s:
+                ev = events[ei]
+                ei += 1
+                if ev.kind == "kill":
+                    # defer further, to the epoch after a completing
+                    # fence: with nothing pending, recovery appends no
+                    # IGNORE_CHECKPOINT determinants and the digest
+                    # chain stays control-comparable (module docstring)
+                    pending_kills.append(ev)
+                    force_complete = True
+                else:
+                    due.append(ev)
+            self._leadership_gate(now_s)
+            # -- one chunk of supersteps (the token's worth of load)
+            send_wall = _time.monotonic()
+            for _ in range(cfg.chunk_steps):
+                r.step()
+            if h.gray_delay_s:
+                # the degraded worker stretches the chunk's transport
+                _time.sleep(h.gray_delay_s)
+            done_wall = _time.monotonic()
+            now_s = done_wall - t0
+            sent_chunks += 1
+            sent_records += chunk_records
+            self._rate_now = sent_records / max(now_s, 1e-9)
+            self.slo.observe(now_s,
+                             corrected_ms=(now_s - intended_s) * 1e3,
+                             actual_ms=(done_wall - send_wall) * 1e3,
+                             records=chunk_records)
+            # -- collected events fire mid-epoch, right after a chunk
+            for ev in due:
+                h.apply(ev, now_s)
+                self.slo.observe_fault(now_s, ev.kind)
+            due.clear()
+            # -- armed kills fire mid-epoch, right after a chunk
+            if kill_armed and pending_kills and ex.step_in_epoch > 0:
+                for ev in pending_kills:
+                    h.apply(ev, now_s)
+                    self.slo.observe_fault(now_s, ev.kind)
+                    if h.recoveries_ms:
+                        self.slo.observe_recovery(
+                            now_s, h.recoveries_ms[-1])
+                pending_kills.clear()
+                kill_armed = False
+            # -- epoch fence
+            if ex.step_in_epoch >= spe:
+                complete = (force_complete
+                            or fences % cfg.complete_every == 0)
+                r.run_epoch(complete_checkpoint=complete)
+                fences += 1
+                if complete:
+                    # abandon OLDER skipped fences' checkpoints: a
+                    # completing fence must leave nothing pending, or
+                    # the next kill's recovery ignores them and the
+                    # IGNORE determinants diverge from the control
+                    r.coordinator.discard_pending_through(
+                        ex.epoch_id - 1)
+                    force_complete = False
+                    kill_armed = bool(pending_kills)
+                if h.audit_pending:
+                    h.audit_check()
+                    h.audit_pending = False
+            h.tick(now_s)
+
+        # -- drain: still-pending kills get their completed fence first
+        # (same no-IGNORE invariant as the paced path), then the last
+        # epoch closes and the final audit sweep covers every seal.
+        now_s = _time.monotonic() - t0
+        if due:
+            if ex.step_in_epoch == 0:
+                for _ in range(cfg.chunk_steps):
+                    r.step()
+            for ev in due:
+                h.apply(ev, now_s)
+                self.slo.observe_fault(now_s, ev.kind)
+            due.clear()
+        if pending_kills:
+            r.run_epoch(complete_checkpoint=True)
+            r.coordinator.discard_pending_through(ex.epoch_id - 1)
+            for _ in range(cfg.chunk_steps):
+                r.step()
+            for ev in pending_kills:
+                h.apply(ev, now_s)
+                self.slo.observe_fault(now_s, ev.kind)
+                if h.recoveries_ms:
+                    self.slo.observe_recovery(now_s,
+                                              h.recoveries_ms[-1])
+        h.tick(float("inf"))
+        r.run_epoch(complete_checkpoint=True)
+        h.audit_check()
+        wall_s = _time.monotonic() - t0
+        return self._verdict(wall_s, sent_records, ei)
+
+    # --- verdict -------------------------------------------------------------
+
+    def _verdict(self, wall_s: float, sent_records: int,
+                 events_fired: int) -> Dict[str, Any]:
+        h, cfg = self.harness, self.cfg
+        windows = self.slo.finish()
+        corrected = self.slo.all_corrected_ms()
+        actual = self.slo.all_actual_ms()
+        audited = h.control is not None and h.runner.auditor.enabled
+        audit_ok = audited and not h.divergences
+        exactly_once = (audit_ok and h.epochs_checked > 0) \
+            if audited else None
+        breached = self.slo.breached_windows()
+        slo_ok = not breached
+        passed = slo_ok and (not self.spec.exactly_once
+                             or bool(exactly_once))
+        worst = self.slo.worst_window()
+        out = {
+            "metric": "soak_slo_verdict",
+            "pass": passed,
+            "rate_target": cfg.rate,
+            "rate_achieved": round(sent_records / max(wall_s, 1e-9), 1),
+            "duration_s": round(wall_s, 2),
+            "records": sent_records,
+            "latency": {
+                "basis": "corrected (intended-send time; "
+                         "coordinated-omission-free)",
+                "p50_ms": round(quantile(corrected, 0.50), 3),
+                "p99_ms": round(quantile(corrected, 0.99), 3),
+                "p999_ms": round(quantile(corrected, 0.999), 3),
+                "actual_send_p99_ms": round(quantile(actual, 0.99), 3),
+            },
+            "windows": [w.stats() for w in windows],
+            "worst_window": worst.stats() if worst else None,
+            "windows_breached": len(breached),
+            "faults": {
+                "injected": h.faults_injected,
+                "survived": h.faults_survived,
+                "by_kind": dict(sorted(h.by_kind.items())),
+                "recoveries_ms": [round(m, 1)
+                                  for m in h.recoveries_ms],
+            },
+            "audit": {
+                "enabled": audited,
+                "exactly_once": exactly_once,
+                "epochs_checked": h.epochs_checked,
+                "divergences": h.divergences[:8],
+            },
+            "slo": self.spec.to_dict(),
+            "events_fired": events_fired,
+            "schedule": self.schedule.to_text(),
+            "truncated": self._truncated,
+        }
+        return out
+
+
+def default_kill_targets(job) -> List[int]:
+    """One flat subtask per vertex class (subtask 1 where parallelism
+    allows, else 0) — the config4 cascading-failure pattern. A cascade
+    drawn from this pool never takes out ALL replicas of one vertex,
+    which would leave no survivor holding the dead task's determinant
+    log (unrecoverable by design, not a harness bug)."""
+    return [job.subtask_base(v.vertex_id) + min(1, v.parallelism - 1)
+            for v in job.vertices]
+
+
+def next_soak_artifact_path(root: Optional[str] = None) -> str:
+    """Next free ``SOAK_r0N.json`` slot next to the BENCH artifacts."""
+    root = root or os.getcwd()
+    n = 1
+    while os.path.exists(os.path.join(root, f"SOAK_r{n:02d}.json")):
+        n += 1
+    return os.path.join(root, f"SOAK_r{n:02d}.json")
+
+
+def build_soak_fixture(workdir: str, rate: float, duration_s: float,
+                       steps_per_epoch: int = 64, par: int = 2,
+                       batch: int = 8, seed: int = 11,
+                       audit: bool = True, lease_ttl_s: float = 2.0,
+                       num_keys: int = 101):
+    """Construct the soak trio: runner, fault-free control twin, and a
+    held leader lease — same job, same seed, logical time on BOTH
+    runners (digest chains are only byte-comparable across runs when
+    timestamps are causal step counts, the multichip-probe precedent).
+
+    Sizing: the ring must hold the longest un-truncated span
+    (``complete_every`` epochs plus the live one), the log the same span
+    of determinant rows, and ``max_epochs`` the whole run plus warmup
+    slack — all rounded to powers of two, the bench idiom.
+    """
+    from clonos_tpu.api.environment import StreamEnvironment
+    from clonos_tpu.runtime.cluster import ClusterRunner
+    from clonos_tpu.runtime.executor import DETS_PER_STEP
+    from clonos_tpu.runtime.leader import FileLeaderElection
+
+    def build():
+        env = StreamEnvironment(name="soak", num_key_groups=16)
+        (env.synthetic_source(vocab=num_keys, batch_size=batch,
+                              parallelism=par)
+            .key_by()
+            .window_count(num_keys=num_keys, window_size=1 << 30,
+                          name="window")
+            .sink())
+        return env.build()
+
+    records_per_step = par * batch
+    expected_epochs = int(np.ceil(
+        duration_s * rate / (records_per_step * steps_per_epoch)))
+    max_epochs = 1 << (expected_epochs + 8).bit_length()
+    span = 4 * steps_per_epoch
+    log_capacity = 1 << (2 * span * DETS_PER_STEP).bit_length()
+    ring_steps = 1 << (span - 1).bit_length()
+
+    def runner_for(sub):
+        return ClusterRunner(
+            build(), steps_per_epoch=steps_per_epoch,
+            log_capacity=log_capacity, max_epochs=max_epochs,
+            inflight_ring_steps=ring_steps,
+            checkpoint_dir=os.path.join(workdir, sub),
+            audit=audit, logical_time=True, seed=seed)
+
+    runner = runner_for("run")
+    control = runner_for("control") if audit else None
+    election = FileLeaderElection(os.path.join(workdir, "lease"),
+                                  "soak-driver", lease_ttl_s=lease_ttl_s)
+    election.try_acquire()
+    return runner, control, election
