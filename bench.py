@@ -322,6 +322,128 @@ def overhead_probe():
         prof_mod.reset_profile()
 
 
+def ablation_probe():
+    """FT-cost ablation (``bench.py --ablate``): the no-FT twin
+    (analysis/ablate.py) head-to-head against the real executor on the
+    same job, same seed, ``logical_time=True`` — so both see identical
+    causal inputs and the twin's outputs are asserted bit-identical
+    before its time is trusted. The wall delta is the *measured*
+    ft-fraction; the census cost model (analysis/census.py) predicts a
+    *static* ft-fraction from the same source; their relative error is
+    the model's report card. The profiler's ``overhead.ft-fraction``
+    gauge rides along as the third, runtime view (host-visible FT
+    sections only — the in-block append cost is jitted away from it,
+    so it lower-bounds the measured number)."""
+    import gc
+    import jax
+    from clonos_tpu.analysis import (ablated_executor, build_census,
+                                     static_cost_model)
+    from clonos_tpu.analysis.census import _repo_contexts, fingerprint
+    from clonos_tpu.obs import profile as prof_mod
+    from clonos_tpu.runtime import executor as real_ex
+    from clonos_tpu.runtime.executor import DETS_PER_STEP
+
+    SPE = int(os.environ.get("BENCH_ABLATE_SPE", 512))
+    EPOCHS = int(os.environ.get("BENCH_ABLATE_EPOCHS", 3))
+    twin_mod, report = ablated_executor()
+
+    def drive(ex_mod, profiled=False):
+        job = build_job()
+        need = (EPOCHS + 1) * SPE * DETS_PER_STEP
+        ex = ex_mod.LocalExecutor(
+            job, steps_per_epoch=SPE,
+            log_capacity=1 << need.bit_length(), max_epochs=16,
+            inflight_ring_steps=1 << (SPE - 1).bit_length(),
+            block_steps=min(256, SPE), seed=7, logical_time=True)
+        ex.run_epoch()                       # compile warmup
+        device_sync(ex.carry)
+        prof = prof_mod.get_profiler()
+        t0 = time.monotonic()
+        outs = None
+        for _ in range(EPOCHS):
+            if profiled:
+                ft0 = sum(v for n, v in prof.lifetime().items())
+                e0 = time.monotonic()
+            outs = ex.run_epoch()
+            device_sync(ex.carry)
+            if profiled:
+                # Attribute the epoch's non-FT wall as compute so the
+                # gauge's rollup denominator is the full epoch.
+                ft = sum(v for n, v in prof.lifetime().items()) - ft0
+                wall = time.monotonic() - e0
+                prof.observe("block-drive", max(wall - ft, 0.0),
+                             kind=prof_mod.COMPUTE)
+        wall_s = time.monotonic() - t0
+        digest = (
+            tuple(np.asarray(x) for x in
+                  jax.tree_util.tree_leaves((ex.carry.op_states,
+                                             ex.carry.edge_bufs,
+                                             ex.carry.record_counts))),
+            tuple(np.asarray(x) for x in
+                  jax.tree_util.tree_leaves(outs.sinks)),
+        )
+        log_head = int(np.asarray(ex.carry.logs.head).max())
+        rings = len(ex.carry.out_rings)
+        subtasks = job.total_subtasks()
+        del ex, job
+        gc.collect()
+        return wall_s, digest, log_head, rings, subtasks
+
+    # Real run, profiled: the runtime gauge's view of the same epochs.
+    prof_mod.configure_profile()
+    try:
+        t_real, d_real, head_real, rings, subtasks = drive(
+            real_ex, profiled=True)
+        prof = prof_mod.get_profiler()
+        prof.rollup()
+        gauge = prof.snapshot()
+    finally:
+        prof_mod.reset_profile()
+    t_twin, d_twin, head_twin, _r, _s = drive(twin_mod)
+
+    # Equivalence gate: the twin only measures FT cost if everything
+    # BUT the logs is bit-identical.
+    real_leaves = d_real[0] + d_real[1]
+    twin_leaves = d_twin[0] + d_twin[1]
+    identical = (len(real_leaves) == len(twin_leaves) and all(
+        np.array_equal(a, b)
+        for a, b in zip(real_leaves, twin_leaves)))
+    if not identical:
+        raise AssertionError(
+            "ablation twin diverged from the real executor — the "
+            "no-FT transform is not semantics-preserving for this "
+            "job; refusing to report an ft-fraction")
+    assert head_real > 0 and head_twin == 0, \
+        (head_real, head_twin)
+
+    measured = max(0.0, (t_real - t_twin) / t_real) if t_real else 0.0
+    ctxs = _repo_contexts(("clonos_tpu", "examples"))
+    census = build_census(ctxs)
+    model = static_cost_model(
+        census, steps_per_epoch=SPE, subtasks=subtasks,
+        records_per_step=BATCH * PAR, ring_vertices=rings,
+        record_touches=4)
+    static = model["ft_fraction_static"]
+    rel_err = abs(static - measured) / max(abs(measured), 1e-9)
+    return {
+        "ft_fraction_measured": round(measured, 6),
+        "ft_fraction_static": static,
+        "model_rel_error": round(rel_err, 6),
+        "ft_fraction_gauge": gauge["lifetime_ft_fraction"],
+        "t_real_s": round(t_real, 4),
+        "t_twin_s": round(t_twin, 4),
+        "epochs": EPOCHS,
+        "steps_per_epoch": SPE,
+        "subtasks": subtasks,
+        "stripped_sites": len(report.stripped),
+        "outputs_bit_identical": True,
+        "log_rows_real": head_real,
+        "log_rows_twin": head_twin,
+        "static_model": model,
+        "census_fingerprint": fingerprint(census),
+    }
+
+
 def multi_job_probe(n_jobs: int):
     """Multi-job throughput probe (``bench.py --jobs N`` /
     ``clonos_tpu bench --jobs N``): N independent small jobs sharing one
@@ -542,10 +664,16 @@ def soak_probe(duration_s: float = 30.0):
         "audit": v["audit"],
         "schedule": v["schedule"],
         "truncated": v["truncated"],
+        "census_fingerprint": v.get("census_fingerprint"),
     }
 
 
-def main(jobs=None, multichip=None, soak=None):
+def main(jobs=None, multichip=None, soak=None, ablate=False):
+    if ablate:
+        # --ablate: run ONLY the no-FT ablation probe (one JSON line,
+        # same contract as the headline bench).
+        print(json.dumps(ablation_probe()))
+        return
     if soak:
         # --soak [SECONDS]: run ONLY the open-loop soak probe (one JSON
         # line, same contract as the headline bench).
@@ -773,6 +901,13 @@ def main(jobs=None, multichip=None, soak=None):
         except Exception as e:                        # pragma: no cover
             out["overhead_probe"] = {"error": str(e)}
             out["overhead_ft_fraction"] = None
+    # The FT call-site population these numbers were measured against
+    # (analysis/census.py): ties the artifact to the exact source shape.
+    try:
+        from clonos_tpu.analysis import census_fingerprint
+        out["census_fingerprint"] = census_fingerprint()
+    except Exception:                                 # pragma: no cover
+        out["census_fingerprint"] = None
     print(json.dumps(out))
 
 
@@ -793,5 +928,10 @@ if __name__ == "__main__":
                     help="run the open-loop soak probe (fixed-rate "
                          "load + seeded chaos + exactly-once audit) "
                          "instead of the headline bench")
+    ap.add_argument("--ablate", action="store_true",
+                    help="run the no-FT ablation probe (twin executor "
+                         "head-to-head, measured vs static ft-fraction) "
+                         "instead of the headline bench")
     _a = ap.parse_args()
-    sys.exit(main(jobs=_a.jobs, multichip=_a.multichip, soak=_a.soak))
+    sys.exit(main(jobs=_a.jobs, multichip=_a.multichip, soak=_a.soak,
+                  ablate=_a.ablate))

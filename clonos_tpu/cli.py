@@ -146,7 +146,8 @@ def cmd_bench(args) -> int:
     import bench
     bench.main(jobs=getattr(args, "jobs", None),
                multichip=getattr(args, "multichip", None),
-               soak=getattr(args, "soak", None))
+               soak=getattr(args, "soak", None),
+               ablate=getattr(args, "ablate", False))
     return 0
 
 
@@ -658,6 +659,27 @@ def cmd_lint(args) -> int:
     return result.exit_code()
 
 
+def cmd_analyze(args) -> int:
+    """Whole-program static analysis (``clonos_tpu analyze``): the
+    interprocedural passes the per-file lint cannot run — nondet-escape
+    propagation to step functions, the whole-repo lock-order cycle
+    check, and the FT census + static cost model (analysis/). Same
+    waiver file, same ``--report json`` one-liner, same 0/1 exit
+    convention as the lint. Jax-free: runnable from any CI box."""
+    from clonos_tpu import analysis as _an
+
+    result = _an.run_analysis(args.paths, waiver_file=args.waivers,
+                              use_waivers=not args.no_waivers)
+    if args.report == "json":
+        # CI convention: one machine-readable line, exit 0/1.
+        print(_an.format_json(result, with_census=not args.no_census))
+    elif args.census:
+        print(json.dumps(result.census, indent=2, sort_keys=True))
+    else:
+        print(_an.format_text(result, verbose=args.verbose))
+    return result.exit_code()
+
+
 def cmd_top(args) -> int:
     """Live per-worker cluster view (``clonos_tpu top``): poll a
     JobMaster metrics endpoint's /metrics.json and render slots, sealed/
@@ -979,6 +1001,11 @@ def main(argv=None) -> int:
                          "fixed-rate load + seeded chaos + exactly-"
                          "once audit (see `clonos_tpu soak` for the "
                          "full-control version)")
+    pb.add_argument("--ablate", action="store_true",
+                    help="run ONLY the no-FT ablation probe: the "
+                         "semantics-preserving twin head-to-head "
+                         "against the real executor (measured vs "
+                         "static ft-fraction + model relative error)")
     pb.set_defaults(fn=cmd_bench)
 
     pd = sub.add_parser("dryrun", help="multichip sharding dry run")
@@ -1262,6 +1289,36 @@ def main(argv=None) -> int:
     pl.add_argument("-v", "--verbose", action="store_true",
                     help="also print waived findings")
     pl.set_defaults(fn=cmd_lint)
+
+    pa = sub.add_parser("analyze",
+                        help="whole-program static analysis: nondet "
+                             "reachability, lock-order cycles, FT "
+                             "census + cost model")
+    pa.add_argument("paths", nargs="*",
+                    default=["clonos_tpu", "examples"],
+                    help="files or directories (default: clonos_tpu "
+                         "examples)")
+    pa.add_argument("--report", choices=["text", "json"],
+                    default="text",
+                    help="json = one machine-readable line {ok, files, "
+                         "errors, warnings, waived, census_fingerprint, "
+                         "findings, census}; exit 0 clean / 1 on "
+                         "findings")
+    pa.add_argument("--waivers", default=None, metavar="FILE",
+                    help="waiver file (default: ./.clonos-waivers if "
+                         "present)")
+    pa.add_argument("--no-waivers", action="store_true",
+                    help="ignore all waivers (inline and file) — show "
+                         "every raw finding")
+    pa.add_argument("--census", action="store_true",
+                    help="print the full FT census as indented JSON "
+                         "instead of the findings")
+    pa.add_argument("--no-census", action="store_true",
+                    help="omit the census body from --report json "
+                         "(fingerprint stays)")
+    pa.add_argument("-v", "--verbose", action="store_true",
+                    help="also print waived findings")
+    pa.set_defaults(fn=cmd_analyze)
 
     pp = sub.add_parser("top", help="live per-worker cluster view from "
                                     "a JobMaster metrics endpoint")

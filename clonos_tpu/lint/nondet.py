@@ -59,6 +59,14 @@ ENTROPY = {
     "secrets.randbits", "secrets.randbelow", "secrets.choice",
 }
 
+#: process identity: stable within one process, different on every
+#: restart — anything derived from it diverges on recovery exactly like
+#: entropy does (a pid-salted key is the audit_nondet SALT bug in
+#: disguise).
+PROCESS_IDENTITY = {
+    "os.getpid", "os.getppid",
+}
+
 
 class _ResolvedRefRule(Rule):
     """Shared walk: flag every Name/Attribute whose canonical dotted
@@ -140,10 +148,17 @@ class RngRule(_ResolvedRefRule):
 @register_rule
 class EntropyRule(_ResolvedRefRule):
     name = "entropy"
-    description = "os.urandom / uuid / secrets read (fresh per process)"
-    matches = ENTROPY
+    description = ("os.urandom / uuid / secrets / os.getpid read "
+                   "(fresh per process)")
+    matches = ENTROPY | PROCESS_IDENTITY
 
     def message(self, dotted: str) -> str:
+        if dotted in PROCESS_IDENTITY:
+            return (f"`{dotted}` changes on every restart — a value "
+                    f"derived from the process id diverges on recovery "
+                    f"just like entropy; key on logged job/subtask "
+                    f"identity instead, or waive with a justification "
+                    f"if the value is never replayed data")
         return (f"`{dotted}` is fresh entropy every process — a "
                 f"restarted worker computes different values from the "
                 f"same replayed inputs (the audit_nondet SALT bug); "

@@ -93,6 +93,10 @@ class NullProfiler:
     def lifetime(self) -> Dict[str, float]:
         return {}
 
+    def snapshot(self) -> Dict[str, Any]:
+        return {"enabled": False, "ft_fraction": 0.0,
+                "lifetime_ft_fraction": 0.0, "sections": {}}
+
     def close(self) -> None:
         pass
 
@@ -216,6 +220,23 @@ class Profiler:
         """Cumulative seconds per section over the process lifetime."""
         with self._lock:
             return dict(self._life)
+
+    def snapshot(self) -> Dict[str, Any]:
+        """One structured view of the profiler's state: the gauge
+        value, the lifetime fraction, and per-section lifetime seconds
+        with kinds — what ``bench.py --ablate`` records as the runtime
+        side of the FT-cost cross-check."""
+        with self._lock:
+            sections = {n: {"seconds": round(v, 6),
+                            "kind": self._kind.get(n, FT)}
+                        for n, v in sorted(self._life.items())}
+        return {
+            "enabled": True,
+            "ft_fraction": self.ft_fraction(),
+            "lifetime_ft_fraction": round(
+                self.lifetime_ft_fraction(), 6),
+            "sections": sections,
+        }
 
     def close(self) -> None:
         pass

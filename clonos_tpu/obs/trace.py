@@ -156,6 +156,7 @@ class Tracer:
         self._local = threading.local()
         #: the flight recorder: most recent records, bounded
         self._ring: Deque[dict] = collections.deque(maxlen=buffer)
+        # clonos: allow(entropy): trace metadata, never replayed data
         self._pid = os.getpid()
 
     # --- span stack (thread-local parents) -----------------------------------

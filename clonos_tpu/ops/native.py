@@ -44,6 +44,8 @@ def _load():
                 # Build to a private temp path, then atomically publish:
                 # concurrent processes (pytest-xdist, the two-process
                 # remote tests) must never dlopen a half-written ELF.
+                # clonos: allow(entropy): pid only names a private
+                # temp file — never replayed data
                 tmp = f"{so}.tmp.{os.getpid()}"
                 try:
                     subprocess.run(

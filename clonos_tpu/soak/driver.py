@@ -498,6 +498,14 @@ class SoakDriver:
             "schedule": self.schedule.to_text(),
             "truncated": self._truncated,
         }
+        # The FT call-site population this run exercised
+        # (analysis/census.py): SOAK_r0N.json numbers stay traceable
+        # to the exact source shape that produced them.
+        try:
+            from clonos_tpu.analysis import census_fingerprint
+            out["census_fingerprint"] = census_fingerprint()
+        except Exception:                             # pragma: no cover
+            out["census_fingerprint"] = None
         return out
 
 

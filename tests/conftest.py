@@ -55,6 +55,26 @@ def pytest_configure(config):
         raise pytest.UsageError(
             "determinism lint failed (clonos_tpu lint):\n"
             + format_text(result))
+    # Same gate for the whole-program analysis (clonos_tpu analyze):
+    # a nondet escape that reaches a step function, or a lock-order
+    # cycle, fails the session before any test runs. Stale analysis
+    # waivers are warnings — printed, not fatal.
+    import sys as _sys
+    from clonos_tpu.analysis import (format_text as a_format,
+                                     run_analysis)
+    cwd = os.getcwd()
+    os.chdir(_REPO_ROOT)
+    try:
+        aresult = run_analysis(["clonos_tpu", "examples"])
+    finally:
+        os.chdir(cwd)
+    if not aresult.ok:
+        raise pytest.UsageError(
+            "whole-program analysis failed (clonos_tpu analyze):\n"
+            + a_format(aresult))
+    for w in aresult.warnings:
+        print(f"analyze warning: {w.location()}: [{w.rule}] "
+              f"{w.message}", file=_sys.stderr)
 
 
 @pytest.fixture

@@ -107,6 +107,39 @@ def test_entropy_urandom_and_uuid(tmp_path, monkeypatch):
     assert {f.line for f in _hits(res, "entropy")} == {3, 4}
 
 
+def test_entropy_from_import_aliases_flagged(tmp_path, monkeypatch):
+    res = _lint_src(tmp_path, monkeypatch, """\
+        from os import urandom
+        from uuid import uuid4 as mkid
+        SALT = urandom(3)
+        TAG = mkid().hex
+        """, use_waivers=False)
+    assert {f.line for f in _hits(res, "entropy")} == {3, 4}
+
+
+def test_entropy_getpid_dotted_and_aliased(tmp_path, monkeypatch):
+    res = _lint_src(tmp_path, monkeypatch, """\
+        import os
+        from os import getpid as gp
+        KEY = os.getpid() & 0xFF
+        KEY2 = gp() & 0xFF
+        """, use_waivers=False)
+    hits = _hits(res, "entropy")
+    assert {f.line for f in hits} == {3, 4}
+    assert "restart" in hits[0].message
+
+
+def test_entropy_unimported_getpid_name_not_flagged(tmp_path,
+                                                    monkeypatch):
+    # A local function that merely shares the name is not os.getpid.
+    res = _lint_src(tmp_path, monkeypatch, """\
+        def getpid():
+            return 7
+        KEY = getpid()
+        """, use_waivers=False)
+    assert _hits(res, "entropy") == []
+
+
 def test_unordered_iter_set_flagged_sorted_ok(tmp_path, monkeypatch):
     res = _lint_src(tmp_path, monkeypatch, """\
         def bad(xs, out):
