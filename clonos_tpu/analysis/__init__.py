@@ -33,7 +33,8 @@ from clonos_tpu.analysis.census import (build_census,
                                         census_fingerprint,
                                         fingerprint,
                                         static_cost_model)
-from clonos_tpu.analysis.lockorder import LOCK_ORDER, LockOrderGraph
+from clonos_tpu.analysis.lockorder import (LOCK_BALANCE, LOCK_ORDER,
+                                           LockOrderGraph)
 from clonos_tpu.analysis.runner import (ANALYSIS_RULES, NONDET_REACH,
                                         AnalysisResult, format_json,
                                         format_text, run_analysis)
@@ -44,7 +45,7 @@ __all__ = [
     "CallGraph", "FunctionInfo", "STEP_ENTRY_NAMES",
     "build_census", "census_fingerprint", "fingerprint",
     "static_cost_model",
-    "LOCK_ORDER", "LockOrderGraph",
+    "LOCK_BALANCE", "LOCK_ORDER", "LockOrderGraph",
     "ANALYSIS_RULES", "NONDET_REACH", "AnalysisResult",
     "format_json", "format_text", "run_analysis",
 ]

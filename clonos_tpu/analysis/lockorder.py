@@ -35,13 +35,14 @@ import ast
 import dataclasses
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
-from clonos_tpu.lint.core import ERROR, FileContext, Finding, Rule, \
-    register_rule
+from clonos_tpu.lint.core import ERROR, WARNING, FileContext, Finding, \
+    Rule, register_rule
 from clonos_tpu.lint.concurrency import _lock_attr
 
 from clonos_tpu.analysis.callgraph import CallGraph, FunctionInfo
 
 LOCK_ORDER = "lock-order"
+LOCK_BALANCE = "lock-balance"
 
 
 @register_rule
@@ -55,6 +56,20 @@ class LockOrderRule(Rule):
     name = LOCK_ORDER
     description = ("lock acquisition-order cycle across the runtime "
                    "(whole-program: enforced by `clonos_tpu analyze`)")
+
+    def check(self, ctx: FileContext) -> List[Finding]:
+        return []
+
+
+@register_rule
+class LockBalanceRule(Rule):
+    """Registry placeholder for ``lock-balance`` (same arrangement as
+    ``lock-order``: the check runs from ``clonos_tpu analyze``)."""
+
+    name = LOCK_BALANCE
+    description = ("bare .acquire() with no matching .release() in the "
+                   "same function (whole-program: enforced by "
+                   "`clonos_tpu analyze`)")
 
     def check(self, ctx: FileContext) -> List[Finding]:
         return []
@@ -77,6 +92,12 @@ class _FnLocks:
     #: (resolved callee qname, line, locks held at the call)
     calls: List[Tuple[str, int, Tuple[str, ...]]] = \
         dataclasses.field(default_factory=list)
+    #: bare ``lock.acquire()`` statements: (lock, line)
+    bare_acquires: List[Tuple[str, int]] = \
+        dataclasses.field(default_factory=list)
+    #: locks a bare ``lock.release()`` statement releases somewhere in
+    #: this function (any path balances the warning)
+    releases: Set[str] = dataclasses.field(default_factory=set)
 
 
 class LockOrderGraph:
@@ -110,10 +131,14 @@ class LockOrderGraph:
                 if not isinstance(node, ast.ClassDef):
                     continue
                 for sub in ast.walk(node):
-                    if not isinstance(sub, ast.With):
-                        continue
-                    for item in sub.items:
-                        e = item.context_expr
+                    exprs = []
+                    if isinstance(sub, ast.With):
+                        exprs = [i.context_expr for i in sub.items]
+                    elif isinstance(sub, ast.Call) \
+                            and isinstance(sub.func, ast.Attribute) \
+                            and sub.func.attr == "acquire":
+                        exprs = [sub.func.value]
+                    for e in exprs:
                         attr = _lock_attr(e)
                         if attr is not None \
                                 and isinstance(e, ast.Attribute) \
@@ -199,13 +224,37 @@ class LockOrderGraph:
         return f"{owner}.{attr}"
 
     def _walk(self, ctx: FileContext, fi: FunctionInfo,
-              facts: _FnLocks, stmts, held: Tuple[str, ...]) -> None:
+              facts: _FnLocks, stmts,
+              held: Tuple[str, ...]) -> Tuple[str, ...]:
+        # Bare ``lock.acquire()`` / ``lock.release()`` statements change
+        # the held set for SUBSEQUENT statements, so the walk threads
+        # ``held`` through the body in source order (a straight-line
+        # approximation: a branch's acquire stays held afterwards, which
+        # conservatively over-orders rather than missing an edge).
         for stmt in stmts:
-            self._visit(ctx, fi, facts, stmt, held)
+            held = self._visit(ctx, fi, facts, stmt, held)
+        return held
+
+    def _bare_lock_call(self, ctx: FileContext, fi: FunctionInfo,
+                        expr: ast.AST
+                        ) -> Tuple[Optional[str], Optional[str]]:
+        """``self._lock.acquire()`` as a bare statement ->
+        (lock id, "acquire"/"release")."""
+        if isinstance(expr, ast.Call) \
+                and isinstance(expr.func, ast.Attribute) \
+                and expr.func.attr in ("acquire", "release"):
+            lock = self._lock_id(ctx, fi, expr.func.value)
+            if lock is not None:
+                return lock, expr.func.attr
+        return None, None
 
     def _visit(self, ctx: FileContext, fi: FunctionInfo,
                facts: _FnLocks, node: ast.AST,
-               held: Tuple[str, ...]) -> None:
+               held: Tuple[str, ...]) -> Tuple[str, ...]:
+        if fi.name == "<module>" and isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                       ast.ClassDef)):
+            return held     # bodies belong to their own function scans
         if isinstance(node, ast.With):
             inner = held
             for item in node.items:
@@ -215,7 +264,18 @@ class LockOrderGraph:
                     if lock not in inner:
                         inner = inner + (lock,)
             self._walk(ctx, fi, facts, node.body, inner)
-            return
+            return held                 # with-scope restores on exit
+        if isinstance(node, ast.Expr):
+            lock, kind = self._bare_lock_call(ctx, fi, node.value)
+            if kind == "acquire":
+                facts.acquires.append((lock, node.lineno, held))
+                facts.bare_acquires.append((lock, node.lineno))
+                if lock not in held:
+                    held = held + (lock,)
+                return held
+            if kind == "release":
+                facts.releases.add(lock)
+                return tuple(h for h in held if h != lock)
         if isinstance(node, ast.Call):
             dotted = ctx.resolve(node.func)
             if dotted is not None:
@@ -223,7 +283,8 @@ class LockOrderGraph:
                 if tgt is not None and tgt != fi.qname:
                     facts.calls.append((tgt, node.lineno, held))
         for child in ast.iter_child_nodes(node):
-            self._visit(ctx, fi, facts, child, held)
+            held = self._visit(ctx, fi, facts, child, held)
+        return held
 
     # --- interprocedural closure --------------------------------------------
 
@@ -310,4 +371,29 @@ class LockOrderGraph:
                         f"deadlock; pick one global order (or drop a "
                         f"lock scope) and add a waiver only if an "
                         f"external protocol serializes the paths"))
+        out.extend(self.balance_findings())
         return sorted(out, key=lambda f: (f.path, f.line))
+
+    def balance_findings(self) -> List[Finding]:
+        """WARNING per bare ``.acquire()`` whose function never calls
+        ``.release()`` on the same lock: on every path out of that
+        function the lock stays held — either a leak (deadlock the next
+        time anyone takes it) or a cross-function hand-off the analysis
+        cannot see (which deserves the ``with`` form or a waiver)."""
+        out: List[Finding] = []
+        for q, facts in sorted(self._fn_locks.items()):
+            if not facts.bare_acquires:
+                continue
+            fi = self._graph.functions[q]
+            for lock, line in facts.bare_acquires:
+                if lock in facts.releases:
+                    continue
+                out.append(Finding(
+                    rule=LOCK_BALANCE, path=fi.path, line=line,
+                    severity=WARNING,
+                    message=f"{lock}.acquire() here but {q.rsplit('.', 1)[-1]}() "
+                            f"never calls {lock}.release() — the lock "
+                            f"stays held on every exit path; use `with "
+                            f"{lock.rsplit('.', 1)[-1]}:` or release in "
+                            f"a finally block"))
+        return out

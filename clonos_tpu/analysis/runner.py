@@ -37,12 +37,13 @@ from clonos_tpu.lint.waivers import STALE_WAIVER, collect_inline
 
 from clonos_tpu.analysis import census as census_mod
 from clonos_tpu.analysis.callgraph import CallGraph
-from clonos_tpu.analysis.lockorder import LOCK_ORDER, LockOrderGraph
+from clonos_tpu.analysis.lockorder import (LOCK_BALANCE, LOCK_ORDER,
+                                           LockOrderGraph)
 
 NONDET_REACH = "nondet-reach"
 
 #: rules this runner owns (waiver staleness is scoped to these).
-ANALYSIS_RULES = {NONDET_REACH, LOCK_ORDER}
+ANALYSIS_RULES = {NONDET_REACH, LOCK_ORDER, LOCK_BALANCE}
 
 #: per-file rules whose unwaived findings seed the reach propagation.
 TAINT_RULES = ("wallclock", "rng", "entropy")

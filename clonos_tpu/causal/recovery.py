@@ -560,10 +560,16 @@ class RecoveryManager:
         self.plan: Optional[ReplayPlan] = None
         self.result: Optional[ReplayResult] = None
         self.transitions: List[RecoveryState] = [self.state]
+        #: transition observers: ``fn(kind, **fields)`` on every FSM
+        #: state change — the verify conformance layer's observation
+        #: surface (kind is the entered state's name).
+        self.transition_observers: List = []
 
     def _goto(self, s: RecoveryState) -> None:
         self.state = s
         self.transitions.append(s)
+        for fn in self.transition_observers:
+            fn(s.name, flat=self.flat_subtask)
         tr = _get_tracer()
         if tr.enabled:
             # FSM transitions as instants (reference RecoveryManager

@@ -24,6 +24,7 @@ from __future__ import annotations
 import argparse
 import importlib
 import json
+import os
 import sys
 import time
 
@@ -677,6 +678,69 @@ def cmd_analyze(args) -> int:
         print(json.dumps(result.census, indent=2, sort_keys=True))
     else:
         print(_an.format_text(result, verbose=args.verbose))
+    rc = result.exit_code()
+    if args.expect_census is not None:
+        expect = args.expect_census
+        if os.path.isfile(expect):
+            # a pin file (.clonos-census): first token is the pin
+            with open(expect) as f:
+                toks = f.read().split()
+            expect = toks[0] if toks else ""
+        if result.census_fingerprint != expect:
+            print(f"census drift: fingerprint "
+                  f"{result.census_fingerprint} != pinned {expect} — "
+                  f"the FT call-site population changed; review "
+                  f"`clonos_tpu analyze --census` and re-pin the "
+                  f"fingerprint", file=sys.stderr)
+            rc = max(rc, 1)
+    return rc
+
+
+def cmd_verify(args) -> int:
+    """Protocol model checker (``clonos_tpu verify``): exhaustively
+    explore the checkpoint / recovery / lease-fencing / admission
+    transition models at a small bound, checking every safety invariant
+    on every reachable state and bounded liveness on every terminal
+    state. ``--seed-bug model:bug`` injects a named protocol defect
+    (verify/models.py BUGS) — the checker must then find a minimal
+    counterexample (exit 1), which ``--chaos-out`` compiles into a
+    replayable chaos-DSL schedule for `clonos_tpu soak`. Pure Python
+    (no jax) except ``--conformance``, which replays model traces
+    against the real components."""
+    from clonos_tpu import verify as _v
+
+    if args.list_bugs:
+        for model in sorted(_v.BUGS):
+            for bug, what in sorted(_v.BUGS[model].items()):
+                print(f"{model}:{bug:20} {what}")
+        return 0
+    bugs = {}
+    for spec in args.seed_bug:
+        model, sep, bug = spec.partition(":")
+        if not sep:
+            print(f"--seed-bug wants model:bug, got {spec!r} "
+                  f"(see --list-bugs)", file=sys.stderr)
+            return 2
+        bugs[model] = bug
+    try:
+        result = _v.run_verify(
+            models=args.model or None, workers=args.workers,
+            epochs=args.epochs, faults=args.faults, depth=args.depth,
+            max_states=args.max_states, quick=args.quick, bugs=bugs,
+            conformance=args.conformance)
+    except ValueError as exc:
+        print(str(exc), file=sys.stderr)
+        return 2
+    if args.chaos_out:
+        os.makedirs(args.chaos_out, exist_ok=True)
+        for v in result.violations:
+            out = _v.write_counterexample(args.chaos_out, v)
+            print(f"counterexample: {out['chaos']}", file=sys.stderr)
+    if args.report == "json":
+        # CI convention: one machine-readable line, exit 0/1.
+        print(_v.format_json(result))
+    else:
+        print(_v.format_text(result))
     return result.exit_code()
 
 
@@ -1318,7 +1382,56 @@ def main(argv=None) -> int:
                          "(fingerprint stays)")
     pa.add_argument("-v", "--verbose", action="store_true",
                     help="also print waived findings")
+    pa.add_argument("--expect-census", default=None, metavar="FP",
+                    help="census-drift gate: fail (exit 1) unless the "
+                         "census fingerprint equals FP — a hex "
+                         "fingerprint or a pin file like "
+                         "./.clonos-census whose first token is one")
     pa.set_defaults(fn=cmd_analyze)
+
+    pv = sub.add_parser("verify",
+                        help="protocol model checker: exhaustive "
+                             "exploration of the checkpoint/recovery/"
+                             "lease/admission protocols with chaos-"
+                             "replayable counterexamples")
+    pv.add_argument("--model", action="append", default=[],
+                    metavar="NAME",
+                    help="model to check: checkpoint, recovery, lease, "
+                         "admission (repeatable; default: all four)")
+    pv.add_argument("--workers", type=int, default=2,
+                    help="worker/contender count in the bound "
+                         "(default 2)")
+    pv.add_argument("--epochs", type=int, default=2,
+                    help="checkpoint epochs in the bound (default 2)")
+    pv.add_argument("--faults", type=int, default=1,
+                    help="injected faults in the bound (default 1)")
+    pv.add_argument("--depth", type=int, default=48,
+                    help="BFS depth budget (default 48)")
+    pv.add_argument("--max-states", type=int, default=200_000,
+                    help="state budget per model (default 200000)")
+    pv.add_argument("--quick", action="store_true",
+                    help="the session-gate bound: workers=2 epochs=2 "
+                         "faults=1 at reduced depth/state budget "
+                         "(sub-second)")
+    pv.add_argument("--seed-bug", action="append", default=[],
+                    metavar="MODEL:BUG",
+                    help="inject a named protocol defect (repeatable); "
+                         "the checker must find a counterexample "
+                         "(exit 1). See --list-bugs")
+    pv.add_argument("--list-bugs", action="store_true",
+                    help="print the seeded-bug registry and exit")
+    pv.add_argument("--conformance", action="store_true",
+                    help="also replay model traces against the real "
+                         "components and fail on observable-transition "
+                         "divergence (imports the full runtime)")
+    pv.add_argument("--chaos-out", default=None, metavar="DIR",
+                    help="compile each counterexample into a chaos-DSL "
+                         "schedule (.chaos) + trace (.jsonl) under DIR")
+    pv.add_argument("--report", choices=["text", "json"],
+                    default="text",
+                    help="json = one machine-readable line {ok, bound, "
+                         "models, ...}; exit 0 clean / 1 on violations")
+    pv.set_defaults(fn=cmd_verify)
 
     pp = sub.add_parser("top", help="live per-worker cluster view from "
                                     "a JobMaster metrics endpoint")

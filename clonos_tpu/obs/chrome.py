@@ -32,22 +32,14 @@ def load_jsonl(paths) -> List[dict]:
     that truncated tail is expected debris, not corruption, so it is
     dropped silently. A decode failure on any EARLIER line still
     raises — that means the file really is damaged."""
+    from clonos_tpu.utils.jsonl import parse_jsonl_lines
     if isinstance(paths, (str, bytes)):
         paths = [paths]
     records: List[dict] = []
     for path in paths:
         with open(path) as f:
-            lines = [ln.strip() for ln in f]
-        nonempty = [(i, ln) for i, ln in enumerate(lines) if ln]
-        for pos, (i, ln) in enumerate(nonempty):
-            try:
-                records.append(json.loads(ln))
-            except json.JSONDecodeError:
-                if pos == len(nonempty) - 1:
-                    break
-                raise ValueError(
-                    f"{path}:{i + 1}: undecodable trace record "
-                    f"(not a truncated tail)")
+            lines = f.read().splitlines()
+        records.extend(parse_jsonl_lines(lines, label=str(path)))
     records.sort(key=lambda r: r.get("ts", 0.0))
     return records
 

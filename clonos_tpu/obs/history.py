@@ -36,21 +36,8 @@ from typing import Any, Callable, Deque, Dict, List, Optional
 def read_history_file(path: str) -> List[dict]:
     """Read a history JSONL, tolerating a torn final line (SIGKILL mid
     append); a decode failure on any earlier line still raises."""
-    if not os.path.exists(path):
-        return []
-    out: List[dict] = []
-    with open(path) as f:
-        lines = f.read().splitlines()
-    for i, line in enumerate(lines):
-        if not line.strip():
-            continue
-        try:
-            out.append(json.loads(line))
-        except json.JSONDecodeError:
-            if i == len(lines) - 1:
-                break        # SIGKILL artifact: torn final append
-            raise
-    return out
+    from clonos_tpu.utils.jsonl import read_jsonl
+    return read_jsonl(path)
 
 
 class MetricsHistory:

@@ -248,22 +248,8 @@ def read_ledger_file(path: str) -> List[dict]:
     """Read a ledger.jsonl, tolerating a torn final line (SIGKILL mid
     append); a decode failure on any earlier line still raises. Shared
     by FileCheckpointStorage and ``clonos_tpu audit``."""
-    import json
-    if not os.path.exists(path):
-        return []
-    out: List[dict] = []
-    with open(path) as f:
-        lines = f.read().splitlines()
-    for i, line in enumerate(lines):
-        if not line.strip():
-            continue
-        try:
-            out.append(json.loads(line))
-        except json.JSONDecodeError:
-            if i == len(lines) - 1:
-                break        # SIGKILL artifact: torn final append
-            raise
-    return out
+    from clonos_tpu.utils.jsonl import read_jsonl
+    return read_jsonl(path)
 
 
 def carry_to_host(carry) -> Any:
@@ -330,10 +316,20 @@ class CheckpointCoordinator:
         self._complete_listeners: List[Callable[[int], None]] = []
         self._writer_lock = threading.Lock()
         self._async_threads: List[threading.Thread] = []
+        #: transition observers: ``fn(kind, **fields)`` on every
+        #: protocol-visible transition (trigger/ack/complete/ignore/
+        #: discard). The verify conformance layer replays model traces
+        #: against these; keep callbacks cheap — completion fires them
+        #: on the async writer thread too.
+        self.transition_observers: List[Callable[..., None]] = []
         self._trigger_wall: Dict[int, float] = {}     # cid -> trigger time
         #: cid -> trigger→complete latency (read by the runner's
         #: ``checkpoint.trigger-to-complete-ms`` histogram hook)
         self.completion_latency_s: Dict[int, float] = {}
+
+    def _observe(self, kind: str, **fields) -> None:
+        for fn in self.transition_observers:
+            fn(kind, **fields)
 
     # --- listener registration ----------------------------------------------
 
@@ -362,6 +358,7 @@ class CheckpointCoordinator:
         if checkpoint_id in self._ignored:
             return
         self._pending[checkpoint_id] = set(range(self.num_subtasks))
+        self._observe("trigger", cid=checkpoint_id)
         # clonos: allow(wallclock): trigger->complete latency metric only
         self._trigger_wall[checkpoint_id] = time.time()
         get_tracer().event("checkpoint.trigger", cid=checkpoint_id,
@@ -400,13 +397,18 @@ class CheckpointCoordinator:
         missing = self._pending.get(checkpoint_id)
         if missing is not None:
             missing.discard(subtask)
+            self._observe("ack", cid=checkpoint_id, subtask=subtask)
             self._maybe_complete(checkpoint_id)
 
     def ack_all(self, checkpoint_id: int,
                 except_subtasks: Tuple[int, ...] = ()) -> None:
         missing = self._pending.get(checkpoint_id)
         if missing is not None:
+            acked = missing - set(except_subtasks)
             missing.intersection_update(except_subtasks)
+            for subtask in sorted(acked):
+                self._observe("ack", cid=checkpoint_id,
+                              subtask=subtask)
             self._maybe_complete(checkpoint_id)
 
     def discard_pending_through(self, checkpoint_id: int) -> List[int]:
@@ -424,6 +426,7 @@ class CheckpointCoordinator:
         for cid in cids:
             self._ignored.add(cid)
             del self._pending[cid]
+            self._observe("discard", cid=cid)
         return cids
 
     def _maybe_complete(self, checkpoint_id: int) -> None:
@@ -438,6 +441,7 @@ class CheckpointCoordinator:
         if checkpoint_id in self._pending:
             del self._pending[checkpoint_id]
             self._completed_ids.append(checkpoint_id)
+            self._observe("complete", cid=checkpoint_id)
             # mark_complete rewrites storage metadata; every other
             # storage mutation (write/delete/compact_ledger) holds
             # _writer_lock, and _maybe_complete runs on both the async
@@ -511,6 +515,7 @@ class CheckpointCoordinator:
         for cid in dead:
             self._ignored.add(cid)
             del self._pending[cid]
+            self._observe("ignore", cid=cid)
         return sorted(dead)
 
     def backoff(self) -> int:

@@ -155,6 +155,15 @@ class AdmissionController:
         self._held: Dict[str, int] = {}
         self._queue: Deque[str] = collections.deque()
         self._pending: Dict[str, Tuple[str, int]] = {}
+        #: transition observers: ``fn(kind, **fields)`` on every
+        #: admission transition (admit/queue/reject/cancel/release) —
+        #: the verify conformance layer's observation surface. Called
+        #: under the Dispatcher's lock like everything else here.
+        self.transition_observers: List = []
+
+    def _observe(self, kind: str, **fields) -> None:
+        for fn in self.transition_observers:
+            fn(kind, **fields)
 
     def quota(self, tenant: str) -> Optional[int]:
         return self.quotas.get(tenant, self.default_quota)
@@ -180,13 +189,19 @@ class AdmissionController:
         earlier ones even when slots happen to be free for them."""
         q = self.quota(tenant)
         if q is not None and self.reserved(tenant) + slots > q:
+            self._observe("reject", job_id=job_id, tenant=tenant,
+                          slots=slots)
             raise QuotaExceededError(tenant, slots, q,
                                      self.reserved(tenant))
         if self._queue or free_slots < slots:
             self._queue.append(job_id)
             self._pending[job_id] = (tenant, slots)
+            self._observe("queue", job_id=job_id, tenant=tenant,
+                          slots=slots)
             return "queued"
         self._held[tenant] = self.held(tenant) + slots
+        self._observe("admit", job_id=job_id, tenant=tenant,
+                      slots=slots)
         return "admitted"
 
     def admit_queued(self, free_slots: int) -> List[str]:
@@ -203,18 +218,24 @@ class AdmissionController:
             del self._pending[jid]
             self._held[tenant] = self.held(tenant) + slots
             free_slots -= slots
+            self._observe("admit", job_id=jid, tenant=tenant,
+                          slots=slots)
             out.append(jid)
         return out
 
     def cancel_queued(self, job_id: str) -> bool:
         if job_id not in self._pending:
             return False
+        tenant, slots = self._pending[job_id]
         del self._pending[job_id]
         self._queue.remove(job_id)
+        self._observe("cancel", job_id=job_id, tenant=tenant,
+                      slots=slots)
         return True
 
     def release(self, tenant: str, slots: int) -> None:
         self._held[tenant] = max(0, self.held(tenant) - int(slots))
+        self._observe("release", tenant=tenant, slots=int(slots))
 
 
 #: job lifecycle: QUEUED -> ADMITTED -> DEPLOYING -> RUNNING ->

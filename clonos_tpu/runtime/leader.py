@@ -74,6 +74,14 @@ class FileLeaderElection:
         self._clock = time.time if clock is None else clock
         #: fencing token of OUR current leadership (None = not leader)
         self.epoch: Optional[int] = None
+        #: transition observers: ``fn(kind, **fields)`` on every
+        #: leadership transition (claim/renew/deposed/lost-race) —
+        #: the verify conformance layer's observation surface.
+        self.transition_observers: List = []
+
+    def _observe(self, kind: str, **fields) -> None:
+        for fn in self.transition_observers:
+            fn(kind, **fields)
 
     # --- claim files ---------------------------------------------------------
 
@@ -144,6 +152,7 @@ class FileLeaderElection:
             if cur.get("leader_id") == self.contender_id:
                 self.epoch = cur["epoch"]
                 self._write_own(self.epoch, self._clock() + self.ttl)
+                self._observe("renew", epoch=self.epoch)
                 return True
             return False
         new_epoch = (cur["epoch"] + 1) if cur is not None else 1
@@ -152,10 +161,12 @@ class FileLeaderElection:
                          os.O_CREAT | os.O_EXCL | os.O_WRONLY)
         except FileExistsError:
             self.epoch = None
+            self._observe("lost-race", epoch=new_epoch)
             return False               # lost the race for this epoch
         os.close(fd)
         self._write_own(new_epoch, self._clock() + self.ttl)
         self.epoch = new_epoch
+        self._observe("claim", epoch=new_epoch)
         # Superseded claims (< epoch-1) can never be read again.
         for e in self._claims():
             if e < new_epoch - 1:
@@ -174,9 +185,12 @@ class FileLeaderElection:
             return False
         claims = self._claims()
         if not claims or claims[-1] != self.epoch:
+            deposed = self.epoch
             self.epoch = None          # deposed: a higher claim exists
+            self._observe("deposed", epoch=deposed)
             return False
         self._write_own(self.epoch, self._clock() + self.ttl)
+        self._observe("renew", epoch=self.epoch)
         return True
 
     def is_leader(self) -> bool:

@@ -456,15 +456,27 @@ class TaskExecutorEndpoint:
         # "" is the legacy single-job cluster.
         self._highest: Dict[str, int] = {}
         self._lock = threading.Lock()
+        #: transition observers: ``fn(kind, **fields)`` on every
+        #: fencing decision (accept / reject-stale / reject-invalid) —
+        #: the verify conformance layer's receiver-side surface.
+        self.transition_observers: List = []
         self.server = tp.ControlServer(self._handle, host, port)
         self.address = self.server.address
 
+    def _observe(self, kind: str, **fields) -> None:
+        for fn in self.transition_observers:
+            fn(kind, **fields)
+
     def _check_fencing(self, epoch, job_id: str = "") -> None:
         if epoch is None:
+            self._observe("fence-reject", job_id=job_id, epoch=None,
+                          why="missing")
             raise PermissionError("DEPLOY carries no fencing token")
         epoch = int(epoch)
         with self._lock:
             if epoch < self._highest.get(job_id, -1):
+                self._observe("fence-reject", job_id=job_id,
+                              epoch=epoch, why="stale")
                 raise PermissionError(
                     f"stale fencing token {epoch} < highest accepted "
                     f"{self._highest[job_id]} (deposed JobMaster)")
@@ -472,12 +484,15 @@ class TaskExecutorEndpoint:
             observer = FileLeaderElection(
                 job_lease_path(self._lease_path, job_id), "observer")
             if not observer.fencing_valid(epoch):
+                self._observe("fence-reject", job_id=job_id,
+                              epoch=epoch, why="not-current-claim")
                 raise PermissionError(
                     f"fencing token {epoch} is not the current lease "
                     f"claim — deposed or forged JobMaster identity")
         with self._lock:
             self._highest[job_id] = max(self._highest.get(job_id, -1),
                                         epoch)
+        self._observe("fence-accept", job_id=job_id, epoch=epoch)
 
     def _handle(self, mtype: int, payload: bytes) -> Tuple[int, bytes]:
         if mtype != tp.DEPLOY:

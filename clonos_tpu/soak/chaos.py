@@ -135,6 +135,35 @@ def _parse_event(line: str) -> ChaosEvent:
                       hold_s=hold_s)
 
 
+def event_from_dict(d: dict) -> ChaosEvent:
+    """Build an event from its dict form (the verify counterexample
+    trace records' ``chaos`` field; inverse of the bridge's record
+    writer)."""
+    kind = d.get("kind")
+    if kind not in FAULT_KINDS:
+        raise ValueError(f"chaos record {d!r}: unknown kind {kind!r}")
+    return ChaosEvent(
+        at_s=float(d.get("at_s", 0.0)), kind=kind,
+        targets=tuple(int(t) for t in d.get("targets") or ()),
+        delay_s=float(d.get("delay_s", 0.0)),
+        duration_s=float(d.get("duration_s", 0.0)),
+        hold_s=float(d.get("hold_s", 0.0)))
+
+
+def read_trace_schedule(path: str) -> "ChaosSchedule":
+    """Import a verify counterexample trace (JSONL, one record per
+    model-trace step) as a schedule: the records whose ``chaos`` field
+    is set are the steps with a live-fault analog. Tail-tolerant like
+    every other append log (utils/jsonl.py)."""
+    from clonos_tpu.utils.jsonl import read_jsonl
+    events = []
+    for rec in read_jsonl(path, label=path):
+        ev = rec.get("chaos") if isinstance(rec, dict) else None
+        if ev:
+            events.append(event_from_dict(ev))
+    return ChaosSchedule(events)
+
+
 def parse_schedule(text: str) -> "ChaosSchedule":
     """Parse DSL text into a schedule (events sorted by fire time)."""
     events = []

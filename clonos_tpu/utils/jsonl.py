@@ -1,0 +1,52 @@
+"""Tail-tolerant JSONL reading, shared by every append-only log.
+
+Three subsystems append flushed JSON lines and expect a SIGKILLed
+writer to leave at most one torn final record: the checkpoint epoch
+ledger (runtime/checkpoint.py), the metrics history ring
+(obs/history.py), and flight-recorder traces (obs/chrome.py). The
+verify counterexample traces (verify/bridge.py, soak/chaos.py) use the
+same format. They all share one resolution rule, implemented here once:
+
+- blank lines are skipped;
+- a decode failure on the LAST non-empty line is the expected SIGKILL
+  artifact and is dropped silently;
+- a decode failure on any earlier line is real corruption and raises —
+  ``json.JSONDecodeError`` by default, or ``ValueError`` naming
+  ``<label>:<lineno>`` when the caller passes ``label`` (the trace
+  readers' convention).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Optional, Sequence
+
+
+def parse_jsonl_lines(lines: Sequence[str],
+                      label: Optional[str] = None) -> List[dict]:
+    """Decode JSONL lines under the shared torn-tail rule above."""
+    nonempty = [(i, ln) for i, ln in enumerate(lines) if ln.strip()]
+    out: List[dict] = []
+    for pos, (i, ln) in enumerate(nonempty):
+        try:
+            out.append(json.loads(ln))
+        except json.JSONDecodeError:
+            if pos == len(nonempty) - 1:
+                break        # SIGKILL artifact: torn final append
+            if label is not None:
+                raise ValueError(
+                    f"{label}:{i + 1}: undecodable record "
+                    f"(not a truncated tail)")
+            raise
+    return out
+
+
+def read_jsonl(path: str, label: Optional[str] = None) -> List[dict]:
+    """Read a JSONL file tail-tolerantly; a missing file is an empty
+    log (the first append creates it)."""
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        lines = f.read().splitlines()
+    return parse_jsonl_lines(lines, label=label)

@@ -75,6 +75,34 @@ def pytest_configure(config):
     for w in aresult.warnings:
         print(f"analyze warning: {w.location()}: [{w.rule}] "
               f"{w.message}", file=_sys.stderr)
+    # Census-drift gate: the pinned fingerprint (.clonos-census) must
+    # match — the FT call-site population changing silently is how a
+    # new unlogged call site slips past review.
+    pin_path = os.path.join(_REPO_ROOT, ".clonos-census")
+    if os.path.isfile(pin_path):
+        with open(pin_path) as f:
+            toks = f.read().split()
+        pinned = toks[0] if toks else ""
+        if aresult.census_fingerprint != pinned:
+            raise pytest.UsageError(
+                f"census drift: fingerprint "
+                f"{aresult.census_fingerprint} != pinned {pinned} "
+                f"(.clonos-census) — the FT call-site population "
+                f"changed; review `clonos_tpu analyze --census`, then "
+                f"re-pin with\n  python -m clonos_tpu.cli analyze "
+                f"--report json | python -c \"import json,sys; "
+                f"print(json.load(sys.stdin)['census_fingerprint'])\" "
+                f"> .clonos-census")
+    # Protocol model-checker gate (clonos_tpu verify --quick): every
+    # safety invariant on every reachable state of the four protocol
+    # models at the quick bound, sub-second and jax-free. A violation
+    # prints the minimal counterexample trace.
+    from clonos_tpu.verify import format_text as v_format, run_verify
+    vresult = run_verify(quick=True)
+    if not vresult.ok:
+        raise pytest.UsageError(
+            "protocol model check failed (clonos_tpu verify --quick):\n"
+            + v_format(vresult))
 
 
 @pytest.fixture

@@ -261,6 +261,88 @@ def test_lock_order_reentrant_not_flagged(tmp_path, monkeypatch):
     assert _hits(res, LOCK_ORDER) == []
 
 
+def test_lock_balance_bare_acquire_without_release_warns(
+        tmp_path, monkeypatch):
+    from clonos_tpu.analysis import LOCK_BALANCE
+    from clonos_tpu.lint.core import WARNING
+
+    res = _analyze_src(tmp_path, monkeypatch, {"locks.py": """\
+        import threading
+
+        class Ledger:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def seal(self, row):
+                self._lock.acquire()
+                return row
+        """}, use_waivers=False)
+    (w,) = _hits(res, LOCK_BALANCE)
+    assert w.severity == WARNING
+    assert "release()" in w.message and "with" in w.message
+    # a warning, not an error: the run still exits 0
+    assert res.exit_code() == 0
+
+
+def test_lock_balance_matched_pair_is_quiet(tmp_path, monkeypatch):
+    from clonos_tpu.analysis import LOCK_BALANCE
+
+    res = _analyze_src(tmp_path, monkeypatch, {"locks.py": """\
+        import threading
+
+        class Ledger:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def seal(self, row):
+                self._lock.acquire()
+                try:
+                    return row
+                finally:
+                    self._lock.release()
+        """}, use_waivers=False)
+    assert _hits(res, LOCK_BALANCE) == []
+
+
+def test_lock_order_sees_bare_acquire_release_pairs(
+        tmp_path, monkeypatch):
+    # The cycle only exists because one leg holds its lock through
+    # bare .acquire()/.release() calls instead of a with block — the
+    # order graph must treat both idioms as the same held region.
+    res = _analyze_src(tmp_path, monkeypatch, {"locks.py": """\
+        import threading
+
+        class Dispatcher:
+            def __init__(self):
+                self._admission_lock = threading.Lock()
+                self.jm = JobMaster()
+
+            def submit(self, job):
+                self._admission_lock.acquire()
+                try:
+                    self.jm.seal(job)
+                finally:
+                    self._admission_lock.release()
+
+        class JobMaster:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def seal(self, job):
+                with self._lock:
+                    return job
+
+            def heartbeat(self, d):
+                with self._lock:
+                    with d._admission_lock:
+                        return 1
+        """}, use_waivers=False)
+    cyc = _hits(res, LOCK_ORDER)
+    assert len(cyc) == 1
+    assert "Dispatcher._admission_lock" in cyc[0].message
+    assert "JobMaster._lock" in cyc[0].message
+
+
 # --- census + cost model -------------------------------------------------
 
 def test_repo_census_sync_lanes_and_fingerprint_stable():
@@ -442,3 +524,19 @@ def test_cli_analyze_census_dump(monkeypatch, capsys):
     assert rc == 0
     assert doc["sync_lanes"] == ["TIMESTAMP", "RNG", "ORDER",
                                  "BUFFER_BUILT"]
+
+
+def test_cli_analyze_expect_census_pin_and_drift(monkeypatch, capsys):
+    """The census-drift gate: the repo's pinned fingerprint
+    (.clonos-census) passes; a wrong pin fails with a drift message
+    naming both fingerprints."""
+    from clonos_tpu import cli
+
+    monkeypatch.chdir(_REPO)
+    rc = cli.main(["analyze", "--expect-census", ".clonos-census"])
+    capsys.readouterr()
+    assert rc == 0
+    rc = cli.main(["analyze", "--expect-census", "0" * 16])
+    err = capsys.readouterr().err
+    assert rc == 1
+    assert "census drift" in err and "0" * 16 in err

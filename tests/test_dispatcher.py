@@ -77,6 +77,53 @@ def test_cancel_queued_and_total_held():
     assert adm.admit_queued(free_slots=8) == []
 
 
+def test_cancel_of_queued_job_releases_its_quota_charge():
+    # A queued job was never admitted, but its reservation charges the
+    # tenant's quota; cancelling it must return that headroom — the
+    # admission model's no-leak invariant (verify/models.py).
+    adm = AdmissionController(quotas={"red": 3})
+    assert adm.request("red-001", "red", 2, free_slots=0) == "queued"
+    assert adm.reserved("red") == 2
+    # 2 queued + 2 requested > quota 3: rejected while the charge holds.
+    with pytest.raises(QuotaExceededError):
+        adm.request("red-002", "red", 2, free_slots=8)
+    assert adm.cancel_queued("red-001") is True
+    assert adm.reserved("red") == 0
+    # The exact submission that was rejected now fits.
+    assert adm.request("red-002", "red", 2, free_slots=8) == "admitted"
+    assert adm.held("red") == 2
+
+
+def test_double_release_clamps_and_never_mints_slots():
+    adm = AdmissionController()
+    assert adm.request("a-001", "a", 2, free_slots=4) == "admitted"
+    adm.release("a", 2)
+    assert adm.held("a") == 0
+    # An erroneous second release of the same job clamps at zero:
+    # no negative held count, no phantom free slots later.
+    adm.release("a", 2)
+    assert adm.held("a") == 0 and adm.total_held() == 0
+    assert adm.request("a-002", "a", 2, free_slots=2) == "admitted"
+    assert adm.held("a") == 2
+
+
+def test_admission_transition_observers_see_every_verdict():
+    adm = AdmissionController(quotas={"red": 2})
+    obs = []
+    adm.transition_observers.append(
+        lambda kind, **f: obs.append((kind, f.get("job_id",
+                                                  f.get("tenant")))))
+    assert adm.request("red-001", "red", 2, free_slots=2) == "admitted"
+    with pytest.raises(QuotaExceededError):
+        adm.request("red-002", "red", 1, free_slots=1)
+    adm.release("red", 2)
+    assert adm.request("red-003", "red", 1, free_slots=0) == "queued"
+    assert adm.cancel_queued("red-003") is True
+    assert obs == [("admit", "red-001"), ("reject", "red-002"),
+                   ("release", "red"), ("queue", "red-003"),
+                   ("cancel", "red-003")]
+
+
 def test_tenant_config_validation_and_from_any():
     cfg = TenantConfig.from_any({"tenant": "red", "slots": 2,
                                  "unknown_knob": 1})

@@ -1,0 +1,339 @@
+"""Protocol model checker (clonos_tpu/verify/): exhaustive exploration
+of the checkpoint / recovery / lease-fencing / admission transition
+models, seeded-bug counterexamples, the counterexample→chaos bridge,
+and the conformance layer that replays model traces against the real
+components.
+
+The acceptance spine: (1) all four models are violation-free at the
+default bound; (2) every seeded bug in verify/models.py BUGS yields a
+MINIMAL counterexample (the invariants are not vacuous); (3) a
+counterexample round-trips through the chaos DSL byte-for-byte and —
+for the audit-bait bug — reproduces the audit divergence on a live
+soak cluster; (4) the real components match the models' observable
+transitions bit-for-bit over model-generated traces.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from clonos_tpu.verify import (BUGS, MODELS, Action, Model, compile_trace,
+                               explore, run_verify, traces,
+                               write_counterexample)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# --- explorer -------------------------------------------------------------
+
+class _Counter(Model):
+    """Toy model: two counters that may each step to 3; invariant
+    forbids both reaching 2+, liveness demands both leave 0."""
+
+    name = "counter"
+
+    def __init__(self, bound=3, bad_pair=True):
+        self.bound = bound
+        self.bad_pair = bad_pair
+
+    def initial_state(self):
+        return (0, 0)
+
+    def enabled(self, state):
+        return [Action("inc", (i,)) for i in (0, 1)
+                if state[i] < self.bound]
+
+    def apply(self, state, action):
+        i = action.args[0]
+        return tuple(v + 1 if j == i else v
+                     for j, v in enumerate(state))
+
+    def invariants(self):
+        if not self.bad_pair:
+            return []
+        return [("not-both-2", lambda s:
+                 "both counters >= 2" if min(s) >= 2 else None)]
+
+    def canon(self, state):
+        return tuple(sorted(state))      # counters are symmetric
+
+    def settled(self, state):
+        return "a counter never moved" if 0 in state else None
+
+
+def test_explorer_finds_minimal_counterexample_bfs():
+    r = explore(_Counter())
+    assert not r.ok
+    v = r.violations[0]
+    # BFS: the first violating state found is at minimal depth (2+2).
+    assert v.depth == 4
+    assert [a.kind for a in v.trace] == ["inc"] * 4
+    assert v.invariant == "not-both-2"
+
+
+def test_explorer_symmetry_canon_dedups_states():
+    r = explore(_Counter(bad_pair=False))
+    # Without canon: (bound+1)^2 = 16 states; with sorted-pair canon
+    # only the triangle remains.
+    assert r.states == 10
+    assert r.ok
+
+
+def test_explorer_liveness_flags_wedged_terminal_states():
+    class Wedge(_Counter):
+        def enabled(self, state):
+            return []                    # initial state is terminal
+
+    r = explore(Wedge(bad_pair=False))
+    assert [v.invariant for v in r.violations] == ["liveness"]
+    assert "never moved" in r.violations[0].detail
+
+
+def test_explorer_truncation_is_reported_not_judged():
+    r = explore(_Counter(bound=50, bad_pair=False), depth=3)
+    assert r.truncated
+    # cut-off states are not deadlocks: no liveness violations
+    assert r.ok
+
+
+def test_traces_prefers_full_protocol_rounds():
+    ts = traces(_Counter(bound=2, bad_pair=False), n=3)
+    assert len(ts) == 3
+    # deepest-first: the first trace reaches the (2, 2) terminal state
+    assert len(ts[0]) == 4
+    sigs = {tuple(a.label() for a in t) for t in ts}
+    assert len(sigs) == 3                # distinct by construction
+
+
+# --- the four models ------------------------------------------------------
+
+def test_all_models_clean_at_default_bound():
+    r = run_verify()
+    assert r.ok and r.exit_code() == 0
+    assert {rep.model for rep in r.reports} == set(MODELS)
+    for rep in r.reports:
+        assert rep.states > 0 and not rep.truncated, rep.model
+
+
+@pytest.mark.parametrize("model,bug", [(m, b) for m in sorted(BUGS)
+                                       for b in sorted(BUGS[m])])
+def test_every_seeded_bug_yields_a_counterexample(model, bug):
+    r = run_verify(models=[model], quick=True, bugs={model: bug})
+    assert not r.ok and r.exit_code() == 1, f"{model}:{bug} not caught"
+    assert r.violations[0].trace          # with a concrete trace
+
+
+def test_lease_bug_counterexample_is_the_minimal_three_steps():
+    r = run_verify(models=["lease"], quick=True,
+                   bugs={"lease": "no-fencing-check"})
+    v = r.violations[0]
+    assert v.invariant == "single-fenced-writer"
+    # The classic split-brain: A acquires, the lease lapses, B acquires
+    # — and with no receiver-side check both tokens stay accepted.
+    assert [a.label() for a in v.trace] == ["acquire(0)", "expire",
+                                            "acquire(1)"]
+
+
+def test_checkpoint_late_ack_regresses_the_truncation_fence():
+    r = run_verify(models=["checkpoint"], quick=True,
+                   bugs={"checkpoint": "late-ack"})
+    v = r.violations[0]
+    assert v.invariant == "truncate-monotone"
+    labels = [a.label() for a in v.trace]
+    # the late completion lands after a newer fence truncated higher
+    assert labels[-1].startswith("ack(1")
+
+
+def test_unknown_model_and_bug_are_rejected():
+    with pytest.raises(ValueError):
+        run_verify(models=["nope"])
+    with pytest.raises(ValueError):
+        run_verify(bugs={"lease": "nope"})
+
+
+@pytest.mark.slow
+def test_full_depth_sweep_is_clean():
+    """The big bound: 3 workers, 3 epochs, 2 faults — tens of
+    thousands of states per model, still violation-free."""
+    r = run_verify(workers=3, epochs=3, faults=2, depth=64,
+                   max_states=500_000)
+    assert r.ok, "\n".join(str(v.to_dict()) for v in r.violations)
+    ckpt = next(rep for rep in r.reports if rep.model == "checkpoint")
+    assert ckpt.states > 5_000           # genuinely exhaustive
+    for model, bugs in BUGS.items():
+        for bug in bugs:
+            rb = run_verify(models=[model], workers=3, epochs=3,
+                            faults=2, depth=64, max_states=500_000,
+                            bugs={model: bug})
+            assert not rb.ok, f"{model}:{bug} escaped the big bound"
+
+
+# --- counterexample -> chaos bridge ---------------------------------------
+
+def test_bridge_round_trips_through_the_chaos_dsl(tmp_path):
+    from clonos_tpu.soak.chaos import parse_schedule, read_trace_schedule
+
+    r = run_verify(models=["lease"], quick=True,
+                   bugs={"lease": "no-fencing-check"})
+    v = r.violations[0]
+    sched = compile_trace(v)
+    assert sched.kinds() == ["leader-loss"]
+    assert parse_schedule(sched.to_text()) == sched
+
+    out = write_counterexample(str(tmp_path), v)
+    assert os.path.exists(out["chaos"]) and os.path.exists(out["trace"])
+    # the .chaos file is valid DSL and equal to the compiled schedule
+    with open(out["chaos"]) as f:
+        assert parse_schedule(f.read()) == sched
+    # the .jsonl trace imports back as the same schedule, and records
+    # every model step (including the ones with no live-fault analog)
+    assert read_trace_schedule(out["trace"]) == sched
+    with open(out["trace"]) as f:
+        recs = [json.loads(ln) for ln in f if ln.strip()]
+    assert [rec["action"] for rec in recs] == ["acquire(0)", "expire",
+                                              "acquire(1)"]
+    assert sum(1 for rec in recs if rec["chaos"]) == 1
+
+
+def test_trace_import_tolerates_a_torn_tail(tmp_path):
+    from clonos_tpu.soak.chaos import read_trace_schedule
+
+    r = run_verify(models=["checkpoint"], quick=True,
+                   bugs={"checkpoint": "unlogged-write"})
+    out = write_counterexample(str(tmp_path), r.violations[0])
+    with open(out["trace"], "a") as f:
+        f.write('{"model": "checkpoint", "truncated-mid-wri')
+    sched = read_trace_schedule(out["trace"])
+    assert sched.kinds() == ["nondet"]   # torn tail dropped, not fatal
+
+
+def test_shared_jsonl_reader_contract(tmp_path):
+    from clonos_tpu.utils.jsonl import read_jsonl
+
+    p = tmp_path / "log.jsonl"
+    assert read_jsonl(str(p)) == []      # missing file: empty log
+    p.write_text('{"a": 1}\n\n{"b": 2}\n{"torn": ')
+    assert read_jsonl(str(p)) == [{"a": 1}, {"b": 2}]
+    # mid-file corruption is NOT a torn tail: it must raise, and with
+    # a label the error names the file and line
+    p.write_text('{"a": 1}\njunk\n{"b": 2}\n')
+    with pytest.raises(json.JSONDecodeError):
+        read_jsonl(str(p))
+    with pytest.raises(ValueError, match=r"log\.jsonl:2"):
+        read_jsonl(str(p), label=str(p))
+
+
+# --- conformance: models vs the real components ---------------------------
+
+def test_conformance_all_components_match_bit_for_bit(tmp_path):
+    from clonos_tpu.verify.conformance import run_conformance
+
+    reports = run_conformance(n_traces=3, workdir=str(tmp_path))
+    assert set(reports) == {"checkpoint", "recovery", "lease",
+                            "admission"}
+    for name, rep in sorted(reports.items()):
+        assert rep.traces >= 3, f"{name}: only {rep.traces} trace(s)"
+        assert rep.steps >= rep.traces   # every trace drove real code
+        assert rep.ok, (f"{name} diverged: "
+                        f"{[d.to_dict() for d in rep.divergences]}")
+
+
+def test_conformance_catches_an_implementation_divergence(tmp_path):
+    """Negative control: sabotage one observable transition and the
+    conformance layer must flag it (divergence fails CI, not silently
+    passes)."""
+    from clonos_tpu.runtime.dispatcher import AdmissionController
+    from clonos_tpu.verify.conformance import conform_admission
+
+    orig = AdmissionController.request
+    def sabotaged(self, job_id, tenant, slots, free_slots):
+        verdict = orig(self, job_id, tenant, slots, free_slots)
+        if verdict == "admitted":        # leak a phantom reservation
+            self._pending[job_id + "-ghost"] = (tenant, 1)
+        return verdict
+    AdmissionController.request = sabotaged
+    try:
+        rep = conform_admission(n_traces=3)
+    finally:
+        AdmissionController.request = orig
+    assert not rep.ok
+    assert any("projection" in str(d.expected) for d in rep.divergences)
+
+
+# --- CLI ------------------------------------------------------------------
+
+def _run_cli(args, timeout=120):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    return subprocess.run(
+        [sys.executable, "-m", "clonos_tpu", "verify"] + args,
+        cwd=REPO, env=env, capture_output=True, text=True,
+        timeout=timeout)
+
+
+def test_cli_verify_quick_report_json_exit_zero():
+    p = _run_cli(["--quick", "--report", "json"])
+    assert p.returncode == 0, p.stderr[-2000:]
+    line = json.loads(p.stdout.strip().splitlines()[-1])
+    assert line["ok"] is True and line["quick"] is True
+    assert {m["model"] for m in line["models"]} == set(MODELS)
+    assert all(m["violations"] == [] for m in line["models"])
+
+
+def test_cli_verify_seeded_bug_exits_one_with_counterexample(tmp_path):
+    p = _run_cli(["--quick", "--model", "lease", "--seed-bug",
+                  "lease:no-fencing-check", "--report", "json",
+                  "--chaos-out", str(tmp_path)])
+    assert p.returncode == 1, p.stderr[-2000:]
+    line = json.loads(p.stdout.strip().splitlines()[-1])
+    (m,) = line["models"]
+    assert m["violations"][0]["trace"] == ["acquire(0)", "expire",
+                                           "acquire(1)"]
+    names = os.listdir(tmp_path)
+    assert any(n.endswith(".chaos") for n in names)
+    assert any(n.endswith(".jsonl") for n in names)
+
+
+def test_cli_verify_bad_arguments_exit_two():
+    assert _run_cli(["--model", "nope"]).returncode == 2
+    assert _run_cli(["--seed-bug", "no-colon"]).returncode == 2
+
+
+# --- the live acceptance chain --------------------------------------------
+
+@pytest.mark.slow
+def test_counterexample_reproduces_audit_divergence_live(tmp_path):
+    """The full bridge, end to end: the checkpoint model with the
+    seeded ``unlogged-write`` bug produces a counterexample whose
+    ``perturb`` step compiles to a ``nondet`` chaos event; importing
+    that schedule from the written trace file and driving a LIVE soak
+    cluster with it must trip the epoch-digest audit — the model's
+    exactly-once-logged invariant and the runtime's audit are catching
+    the same hazard."""
+    from clonos_tpu.soak import (SLOSpec, SoakConfig, SoakDriver,
+                                 build_soak_fixture)
+    from clonos_tpu.soak.chaos import read_trace_schedule
+
+    r = run_verify(models=["checkpoint"], quick=True,
+                   bugs={"checkpoint": "unlogged-write"})
+    v = r.violations[0]
+    assert v.invariant == "exactly-once-logged"
+    out = write_counterexample(str(tmp_path), v, start_s=1.5)
+    sched = read_trace_schedule(out["trace"])
+    assert sched.kinds() == ["nondet"]
+
+    runner, control, election = build_soak_fixture(
+        str(tmp_path / "soak"), rate=1200.0, duration_s=4.0,
+        steps_per_epoch=32, seed=11)
+    driver = SoakDriver(
+        runner, SoakConfig(rate=1200.0, duration_s=4.0, window_s=2.0),
+        schedule=sched, spec=SLOSpec(exactly_once=True),
+        control=control, election=election, records_per_step=16)
+    verdict = driver.run()
+
+    assert verdict["pass"] is False
+    assert verdict["audit"]["exactly_once"] is False
+    assert verdict["audit"]["divergences"]
