@@ -668,7 +668,169 @@ def soak_probe(duration_s: float = 30.0):
     }
 
 
-def main(jobs=None, multichip=None, soak=None, ablate=False):
+def spill_probe():
+    """Tiered-storage probe (``bench.py --spill``): prices the spill
+    fabric (clonos_tpu/storage/) three ways, one JSON line.
+
+    1. **Steady state**: the same job three ways — spill OFF, spill ON
+       under the ``availability`` policy (the production steady state:
+       checkpoints complete every epoch, the ring keeps headroom, ring
+       payloads stay put and only the small determinant windows move),
+       and spill ON ``eager`` (the upper bound: every in-flight byte
+       made durable every epoch). The 5% acceptance bound is
+       availability vs off; eager is reported alongside — on a
+       many-core host its writer thread overlaps compute, on this
+       box's core count it shows up as foreground cost.
+    2. **Deep backlog**: pending epochs accumulate until the replay
+       span EXCEEDS device ring capacity, then a kill — recovery must
+       refill the missing leading steps from the host/disk tiers.
+       Timed, and verified bit-identical: the audit ledger diffs empty
+       against a no-spill control run whose ring holds the whole span
+       (``diff_ledgers == []``).
+    3. **Tiers**: occupancy at the moment of the kill plus cumulative
+       movement counters (the ``spill.*`` gauges' source), emitted as
+       BENCH_r0N.json fields.
+    """
+    import gc
+    import tempfile
+
+    from clonos_tpu.obs.digest import diff_ledgers
+    from clonos_tpu.runtime.cluster import ClusterRunner
+    from clonos_tpu.runtime.executor import DETS_PER_STEP
+
+    SPE = int(os.environ.get("BENCH_SPILL_SPE", 512))
+    EPOCHS = int(os.environ.get("BENCH_SPILL_EPOCHS", 3))
+    FILL = int(os.environ.get("BENCH_SPILL_FILL_EPOCHS", 4))
+
+    def steady(spool_dir, policy=None):
+        job = build_job()
+        need = (EPOCHS + 2) * SPE * DETS_PER_STEP
+        # Ring holds 4 epochs so the availability policy has headroom:
+        # with checkpoints completing every epoch, occupancy stays at
+        # ~0.25 < the 0.3 trigger and nothing needs to move — the
+        # production steady state. Same ring for every mode (eager's
+        # cost is ring-size independent) so the comparison is fair.
+        kw = dict(steps_per_epoch=SPE,
+                  log_capacity=1 << need.bit_length(), max_epochs=16,
+                  inflight_ring_steps=1 << (4 * SPE - 1).bit_length(),
+                  block_steps=min(1024, SPE), seed=7)
+        if spool_dir:
+            kw["spool_dir"] = spool_dir
+            kw["spill_policy"] = policy
+        runner = ClusterRunner(job, **kw)
+        runner.run_epoch(complete_checkpoint=True)    # compile warmup
+        device_sync(runner.executor.carry)
+        t0 = time.monotonic()
+        for _ in range(EPOCHS):                       # pipelined
+            runner.run_epoch(complete_checkpoint=True)
+        device_sync(runner.executor.carry)
+        wall = time.monotonic() - t0
+        drain_s = 0.0
+        if spool_dir:
+            # The writer thread overlaps compute; what's LEFT in its
+            # queue at the fence is the true async residue — timed
+            # separately so steady state measures overlap, not total
+            # spill bandwidth.
+            t1 = time.monotonic()
+            runner.executor.drain_spill()
+            drain_s = time.monotonic() - t1
+        rate = EPOCHS * SPE * PAR * BATCH / wall if wall else 0.0
+        stats = dict(runner.executor.spill_stats()) if spool_dir else {}
+        if spool_dir:
+            stats["drain_residue_ms"] = round(drain_s * 1e3, 1)
+        del runner, job
+        gc.collect()
+        return rate, stats
+
+    def backlog_run(spool_dir, ring_steps, budget):
+        job = build_job()
+        need = (FILL + 2) * SPE * DETS_PER_STEP
+        kw = dict(steps_per_epoch=SPE,
+                  log_capacity=1 << need.bit_length(), max_epochs=16,
+                  inflight_ring_steps=ring_steps,
+                  block_steps=min(1024, SPE), seed=7,
+                  logical_time=True, audit=True)
+        if spool_dir:
+            kw["spool_dir"] = spool_dir
+            kw["spill_host_budget_epochs"] = budget
+        runner = ClusterRunner(job, **kw)
+        runner.run_epoch(complete_checkpoint=True)    # restore point
+        for _ in range(FILL):                         # pending backlog
+            runner.run_epoch(complete_checkpoint=False)
+        device_sync(runner.executor.carry)
+        return runner
+
+    with tempfile.TemporaryDirectory() as td:
+        rate_avail, avail_stats = steady(os.path.join(td, "a"),
+                                         "availability")
+    with tempfile.TemporaryDirectory() as td:
+        rate_eager, eager_stats = steady(os.path.join(td, "e"), "eager")
+    rate_off, _ = steady(None)
+    overhead = ((rate_off - rate_avail) / rate_off) if rate_off else 0.0
+    eager_overhead = ((rate_off - rate_eager) / rate_off
+                      if rate_off else 0.0)
+
+    # Deep backlog: the spill run's ring holds ONE epoch, the replay
+    # span is FILL of them; host budget 1 forces most epochs disk-only.
+    with tempfile.TemporaryDirectory() as td:
+        r = backlog_run(os.path.join(td, "spill"),
+                        ring_steps=1 << (SPE - 1).bit_length(), budget=1)
+        r.executor.drain_spill()
+        occupancy = r.executor.spill_occupancy()
+        r.inject_failure([PAR + 1])                   # window subtask 1
+        t0 = time.monotonic()
+        report = r.recover()
+        device_sync(r.executor.carry)
+        backlog_recovery_ms = (time.monotonic() - t0) * 1e3
+        move_stats = r.executor.spill_stats()
+        ledger_spill = list(r.auditor.ledger())
+        steps_replayed = report.steps_replayed
+        ring_cap = 1 << (SPE - 1).bit_length()
+        del r
+        gc.collect()
+    control = backlog_run(None,
+                          ring_steps=1 << (FILL * SPE).bit_length(),
+                          budget=0)
+    ledger_ctrl = list(control.auditor.ledger())
+    del control
+    gc.collect()
+    problems = diff_ledgers(ledger_ctrl, ledger_spill)
+
+    return {
+        "metric": "spill_throughput_overhead_fraction",
+        "value": round(overhead, 6),
+        "unit": "1 - rate(spill availability)/rate(spill off), steady "
+                "state; eager upper bound reported alongside",
+        "pass": bool(overhead <= 0.05 and not problems
+                     and steps_replayed > ring_cap
+                     and move_stats.get("disk_hits", 0) > 0),
+        "steady_state_records_per_sec_spill_availability":
+            round(rate_avail, 1),
+        "steady_state_records_per_sec_spill_eager": round(rate_eager, 1),
+        "steady_state_records_per_sec_spill_off": round(rate_off, 1),
+        "eager_overhead_fraction": round(eager_overhead, 6),
+        "steady_spill_stats": {"availability": avail_stats,
+                               "eager": eager_stats},
+        "backlog_recovery_ms": round(backlog_recovery_ms, 1),
+        "backlog_steps_replayed": steps_replayed,
+        "backlog_ring_capacity_steps": ring_cap,
+        "backlog_exceeds_ring": bool(steps_replayed > ring_cap),
+        "tier_occupancy_at_kill": occupancy,
+        "spill_movement": move_stats,
+        "digests_equal": not problems,
+        "ledger_diff": problems[:8],
+        "steps_per_epoch": SPE,
+        "fill_epochs": FILL,
+    }
+
+
+def main(jobs=None, multichip=None, soak=None, ablate=False,
+         spill=False):
+    if spill:
+        # --spill: run ONLY the tiered-storage probe (one JSON line,
+        # same contract as the headline bench).
+        print(json.dumps(spill_probe()))
+        return
     if ablate:
         # --ablate: run ONLY the no-FT ablation probe (one JSON line,
         # same contract as the headline bench).
@@ -932,6 +1094,11 @@ if __name__ == "__main__":
                     help="run the no-FT ablation probe (twin executor "
                          "head-to-head, measured vs static ft-fraction) "
                          "instead of the headline bench")
+    ap.add_argument("--spill", action="store_true",
+                    help="run the tiered-storage probe (steady-state "
+                         "throughput spill on vs off + deep-backlog "
+                         "disk-tier recovery, audit-verified) instead "
+                         "of the headline bench")
     _a = ap.parse_args()
     sys.exit(main(jobs=_a.jobs, multichip=_a.multichip, soak=_a.soak,
-                  ablate=_a.ablate))
+                  ablate=_a.ablate, spill=_a.spill))

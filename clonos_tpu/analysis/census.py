@@ -199,7 +199,8 @@ def static_cost_model(census: Dict, *, steps_per_epoch: int,
                       subtasks: int, records_per_step: int,
                       replica_logs: int = 0, ring_vertices: int = 0,
                       record_touches: int = 4,
-                      record_bytes: int = 16) -> Dict:
+                      record_bytes: int = 16,
+                      spill: bool = False) -> Dict:
     """Fold the census with a job shape into the FT cost ledger.
 
     ``record_touches`` is how many vertices each record flows through
@@ -208,6 +209,13 @@ def static_cost_model(census: Dict, *, steps_per_epoch: int,
     predicted ft-fraction is FT bytes moved / total bytes moved per
     epoch — a bandwidth model, cross-checked against the measured
     ablation diff by ``bench.py --ablate``.
+
+    With ``spill=True`` the ledger grows the tiered-storage lanes
+    (storage/tiered.py): every sealed epoch's ring slices AND
+    determinant windows cross the d2h lane into the host tier, then the
+    host→disk lane as checksummed segments — two extra moves of the
+    same bytes, but on the writer thread, so they cost *bandwidth*
+    (modeled here), not fence latency (measured by ``bench --spill``).
     """
     enc = census["encoding"]
     dets = census["dets_per_step"] or 0
@@ -229,7 +237,14 @@ def static_cost_model(census: Dict, *, steps_per_epoch: int,
                   * row)
     data_bytes = (steps_per_epoch * records_per_step
                   * record_touches * record_bytes)
-    ft_bytes = det_bytes + replica_bytes + ring_bytes
+    # Tiered-storage lanes: spilled epoch payload = ring slices + the
+    # owner determinant windows (replicas stay device-only); it crosses
+    # d2h once and host→disk once.
+    spill_payload = (ring_bytes + det_bytes) if spill else 0
+    spill_d2h = spill_payload
+    spill_disk = spill_payload
+    ft_bytes = (det_bytes + replica_bytes + ring_bytes
+                + spill_d2h + spill_disk)
     total = ft_bytes + data_bytes
     return {
         "calls_per_step": dets * subtasks,
@@ -239,6 +254,8 @@ def static_cost_model(census: Dict, *, steps_per_epoch: int,
         "ring_bytes_per_epoch": ring_bytes,
         "wire_bytes_per_epoch": wire_bytes,
         "data_bytes_per_epoch": data_bytes,
+        "spill_d2h_bytes_per_epoch": spill_d2h,
+        "spill_disk_bytes_per_epoch": spill_disk,
         "ft_fraction_static": (round(ft_bytes / total, 6)
                                if total else 0.0),
     }

@@ -300,6 +300,32 @@ def unstack_logs(stacked: ThreadLogState):
     return [jax.tree_util.tree_map(lambda x: x[i], stacked) for i in range(n)]
 
 
+def epoch_row_windows(stacked: ThreadLogState, epoch_slot,
+                      max_rows: int) -> Tuple[jnp.ndarray, jnp.ndarray,
+                                              jnp.ndarray]:
+    """Gather one sealed epoch's determinant window from every stacked log
+    in a single fused device op — the extraction half of tiered spilling
+    (storage/tiered.py): called at the seal point, *before* the roll stamps
+    the next epoch's start, so each log's window is ``[start, head)``.
+
+    Returns ``(rows, counts, starts)`` where ``rows`` is
+    ``int32[L, max_rows, NUM_LANES]`` (rows past a log's count are
+    ring-garbage padding and must be trimmed by the caller), ``counts`` is
+    ``int32[L]`` live rows per log, and ``starts`` is ``int32[L]`` absolute
+    start offsets. ``max_rows`` is a static bound; the caller checks
+    ``counts.max() <= max_rows`` and falls back to an exact host-side
+    extraction on overflow (a mis-sized bound must degrade, not truncate).
+    """
+    epoch_slot = jnp.asarray(epoch_slot, jnp.int32)
+    cap = stacked.rows.shape[1]
+    starts = jnp.take(stacked.epoch_starts, epoch_slot, axis=1)     # [L]
+    idx = starts[:, None] + jnp.arange(max_rows, dtype=jnp.int32)[None, :]
+    pos = idx & (cap - 1)                                           # [L, W]
+    rows = jnp.take_along_axis(stacked.rows, pos[:, :, None], axis=1)
+    counts = stacked.head - starts
+    return rows, counts, starts
+
+
 # --- host-side convenience wrapper (tests / control plane) ------------------
 
 

@@ -516,7 +516,8 @@ def _top_rows(snap):
     def row(eid):
         return workers.setdefault(eid, {
             "slots": None, "groups": set(), "sealed": 0, "validated": 0,
-            "ring": None, "lag": None, "ft": None, "phases": {}})
+            "ring": None, "lag": None, "ft": None,
+            "spill_host": None, "spill_disk": None, "phases": {}})
 
     for key, v in snap.items():
         if not key.startswith("worker."):
@@ -547,6 +548,10 @@ def _top_rows(snap):
             r["lag"] = max(r["lag"] or 0, int(v))
         elif num and rest.endswith(".overhead.ft-fraction"):
             r["ft"] = max(r["ft"] or 0.0, float(v))
+        elif num and rest.endswith(".spill.host-epochs"):
+            r["spill_host"] = (r["spill_host"] or 0) + int(v)
+        elif num and rest.endswith(".spill.disk-epochs"):
+            r["spill_disk"] = (r["spill_disk"] or 0) + int(v)
         elif (isinstance(v, dict) and ".recovery." in rest
               and rest.endswith("-ms") and v.get("count")):
             phase = rest.rsplit(".recovery.", 1)[1][:-len("-ms")]
@@ -558,19 +563,23 @@ def _top_table(snap) -> str:
     """Render one ``clonos_tpu top`` frame from a /metrics.json dict."""
     rows = _top_rows(snap)
     lines = [f"{'WORKER':<18} {'SLOTS':>5} {'GROUPS':>6} {'SEALED':>6} "
-             f"{'VALID':>5} {'RING':>6} {'LAG':>5} {'FT%':>7}  "
-             f"RECOVERY p50 ms"]
+             f"{'VALID':>5} {'RING':>6} {'LAG':>5} {'FT%':>7} "
+             f"{'SPILL':>7}  RECOVERY p50 ms"]
     for eid in sorted(rows):
         r = rows[eid]
         slots = "-" if r["slots"] is None else str(r["slots"])
         ring = "-" if r["ring"] is None else f"{r['ring']:.2f}"
         lag = "-" if r["lag"] is None else str(r["lag"])
         ft = "-" if r["ft"] is None else f"{r['ft'] * 100:.2f}"
+        # tier residency: host-tier / disk-tier sealed epochs held
+        # (the spill.* gauges; storage/tiered.py)
+        spill = ("-" if r["spill_host"] is None and r["spill_disk"] is None
+                 else f"{r['spill_host'] or 0}/{r['spill_disk'] or 0}")
         phases = " ".join(f"{k}={v:.0f}"
                           for k, v in sorted(r["phases"].items()))
         lines.append(f"{eid:<18} {slots:>5} {len(r['groups']):>6} "
                      f"{r['sealed']:>6} {r['validated']:>5} {ring:>6} "
-                     f"{lag:>5} {ft:>7}  {phases}")
+                     f"{lag:>5} {ft:>7} {spill:>7}  {phases}")
     if not rows:
         lines.append("(no worker.* metrics yet)")
     # Per-job section (multi-tenant dispatcher): one row per job id

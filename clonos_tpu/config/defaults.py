@@ -85,6 +85,12 @@ INFLIGHT_AVAILABILITY_TRIGGER = ConfigOption(
     description="Pool availability fraction below which 'availability' "
                 "policy spills.")
 
+INFLIGHT_HOST_BUDGET_EPOCHS = ConfigOption(
+    "taskmanager.inflight.spill.host-budget-epochs", 2,
+    description="Sealed epochs each spill owner keeps resident in the host "
+                "staging tier once their segments are durable; older "
+                "epochs demote to disk-only (storage/tiered.py).")
+
 INFLIGHT_CAPACITY_BATCHES = ConfigOption(
     "taskmanager.inflight.capacity-batches", 256,
     description="Batches retained per edge in the device-resident in-flight "

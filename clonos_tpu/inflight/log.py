@@ -23,14 +23,12 @@ prefetch threads) — re-designed for TPU:
 
 from __future__ import annotations
 
-import os
 import queue
 import threading
 from typing import Iterator, List, NamedTuple, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from clonos_tpu.api.records import RecordBatch
 
@@ -194,100 +192,69 @@ class SpillPolicy:
 class SpillingInFlightLog:
     """Host-side owner of one edge's spilled epochs.
 
-    One file per epoch (``epoch_{id}.npz``) so truncation deletes files —
+    A thin RecordBatch adapter over :class:`storage.TieredEpochStore`
+    (the generalized tier fabric shared with the determinant logs): one
+    checksummed segment file per epoch so truncation deletes files —
     the reference's SpillableSubpartitionInFlightLogger file-per-epoch
-    design. Writes happen on a background thread; ``flush_failure`` keeps
-    the data host-resident (reference keeps the buffer in memory on flush
+    design. Writes (including the device→host copy) happen on the
+    store's background writer; a flush failure keeps the data
+    host-resident (reference keeps the buffer in memory on flush
     failure) so replay still works.
     """
 
     def __init__(self, spool_dir: Optional[str], edge_id: int,
                  policy: str = SpillPolicy.EAGER,
-                 availability_trigger: float = 0.3):
+                 availability_trigger: float = 0.3,
+                 host_budget_epochs: Optional[int] = 2):
+        from clonos_tpu.storage import TieredEpochStore
         self.edge_id = edge_id
         self.policy = policy
         self.availability_trigger = availability_trigger
         self.spool_dir = spool_dir
-        if spool_dir:
-            os.makedirs(spool_dir, exist_ok=True)
-        # epoch -> (start_step, dict-of-arrays or filename)
-        self._epochs: dict = {}
-        self._lock = threading.Lock()
-        self._writer_queue: "queue.Queue" = queue.Queue()
-        self._writer = threading.Thread(target=self._writer_loop, daemon=True)
-        self._writer.start()
+        self.store = TieredEpochStore(
+            spool_dir, f"edge{edge_id}",
+            durable=bool(spool_dir) and policy != SpillPolicy.DISABLED,
+            host_budget_epochs=host_budget_epochs)
 
     def _path(self, epoch: int) -> str:
-        return os.path.join(self.spool_dir,
-                            f"edge{self.edge_id}_epoch{epoch}.npz")
-
-    def _writer_loop(self):
-        while True:
-            item = self._writer_queue.get()
-            if item is None:
-                return
-            epoch, start, arrays = item
-            try:
-                np.savez(self._path(epoch), start=start, **arrays)
-                with self._lock:
-                    # Only demote to file if the epoch wasn't truncated
-                    # while the write raced it.
-                    if epoch in self._epochs:
-                        self._epochs[epoch] = (start, self._path(epoch))
-            except OSError:
-                # Flush failure: keep host-memory copy (reference
-                # FlushCompletedCallback failure path).
-                pass
-            finally:
-                self._writer_queue.task_done()
+        return self.store.segment_path(epoch)
 
     def spill_epoch(self, epoch: int, start_step: int,
                     batches: RecordBatch) -> None:
-        """Accept one closed epoch's stacked steps ([n, P, cap] per field)."""
-        arrays = {
-            "keys": np.asarray(batches.keys),
-            "values": np.asarray(batches.values),
-            "timestamps": np.asarray(batches.timestamps),
-            "valid": np.asarray(batches.valid),
-        }
-        with self._lock:
-            self._epochs[epoch] = (start_step, arrays)
-        if self.spool_dir and self.policy != SpillPolicy.DISABLED:
-            self._writer_queue.put((epoch, start_step, arrays))
+        """Accept one closed epoch's stacked steps ([n, P, cap] per
+        field) — device arrays welcome; the d2h copy overlaps the next
+        epoch's compute on the store's writer thread."""
+        self.store.put(epoch, start_step, {
+            "keys": batches.keys, "values": batches.values,
+            "timestamps": batches.timestamps, "valid": batches.valid,
+        })
 
     def truncate(self, completed_epoch: int) -> None:
-        with self._lock:
-            dead = [e for e in self._epochs if e <= completed_epoch]
-            for e in dead:
-                _, payload = self._epochs.pop(e)
-                if isinstance(payload, str):
-                    try:
-                        os.remove(payload)
-                    except OSError:
-                        pass
+        self.store.truncate(completed_epoch)
 
     def retained_epochs(self) -> List[int]:
-        with self._lock:
-            return sorted(self._epochs)
+        return self.store.retained_epochs()
 
     def load_epoch(self, epoch: int) -> Tuple[int, RecordBatch]:
-        """Synchronous read of one epoch (start_step, steps[n, P, cap])."""
-        with self._lock:
-            start, payload = self._epochs[epoch]
-        if isinstance(payload, str):
-            with np.load(payload) as z:
-                payload = {k: z[k] for k in
-                           ("keys", "values", "timestamps", "valid")}
+        """Synchronous read of one epoch (start_step, steps[n, P, cap])
+        from whichever tier holds it (host buffer or verified disk
+        segment)."""
+        start, payload = self.store.load_epoch(epoch)
         return start, RecordBatch(
             jnp.asarray(payload["keys"]), jnp.asarray(payload["values"]),
             jnp.asarray(payload["timestamps"]), jnp.asarray(payload["valid"]))
 
+    def attach_digest(self, epoch: int, digest: str) -> None:
+        """Pin the audit ledger's ring-channel digest on the spilled
+        epoch's segment (diff_ledgers then verifies refills for free)."""
+        self.store.attach_digest(epoch, digest)
+
     def drain(self) -> None:
         """Block until pending spill writes are durable (tests/shutdown)."""
-        self._writer_queue.join()
+        self.store.drain()
 
     def close(self) -> None:
-        self._writer_queue.put(None)
+        self.store.close()
 
 
 class ReplayIterator:
@@ -326,14 +293,21 @@ class ReplayIterator:
         for e in self._epochs:
             if self._stop:
                 return
-            start, batch = self._log.load_epoch(e)
+            try:
+                item = self._log.load_epoch(e)
+            except Exception as exc:
+                # A torn segment (or any refill failure) must reach the
+                # CONSUMER: dying here would leave it blocked on the
+                # queue forever. The exception rides the queue and
+                # re-raises on the consumer thread.
+                item = exc
             while not self._stop:
                 try:
-                    self._q.put((start, batch), timeout=0.1)
+                    self._q.put(item, timeout=0.1)
                     break
                 except queue.Full:
                     continue
-            if self._stop:
+            if self._stop or isinstance(item, Exception):
                 return
         while not self._stop:
             try:
@@ -350,6 +324,8 @@ class ReplayIterator:
             item = self._q.get()
             if item is None:
                 return
+            if isinstance(item, Exception):
+                raise item
             start, batch = item
             if first and self._skip:
                 start = start + self._skip

@@ -11,9 +11,12 @@ fault-tolerance machinery costs*, continuously, on a live job:
   inside the fused block program, so the host-side attributable
   sections are the block dispatch itself (user compute + fused FT),
   the epoch roll, in-flight truncation, async determinant appends, the
-  lean snapshot, digest sealing, ledger writes, spill, timer
-  advancement, and control-transport send/recv. Each section feeds an
-  ``overhead.<section>-ms`` histogram in the bound metric group.
+  lean snapshot, digest sealing, ledger writes, spill (the fence-side
+  staging; the tiered stores' writer threads report their own
+  ``spill-write`` and recovery its ``refill`` — storage/tiered.py),
+  timer advancement, and control-transport send/recv. Each section
+  feeds an ``overhead.<section>-ms`` histogram in the bound metric
+  group.
 - Sections are tagged ``kind="ft"`` (fault-tolerance overhead) or
   ``kind="compute"`` (user work). :meth:`Profiler.rollup` — called at
   each epoch fence — derives the **``overhead.ft-fraction``** gauge:

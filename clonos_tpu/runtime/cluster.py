@@ -50,6 +50,7 @@ from clonos_tpu.inflight import log as ifl
 from clonos_tpu.parallel import routing
 from clonos_tpu.runtime import checkpoint as cp
 from clonos_tpu.obs import get_tracer
+from clonos_tpu.storage import SegmentCorruptError, StorageError
 from clonos_tpu.runtime.executor import (DETS_PER_STEP, JobCarry,
                                          LeanSnapshot, LocalExecutor)
 
@@ -344,6 +345,25 @@ class ClusterRunner:
         # RIGHT NOW would replay (the recovery-cost exposure).
         g.gauge("backpressure.inflight-occupancy", self._inflight_occupancy)
         g.gauge("recovery.replay-lag-steps", self._replay_lag_steps)
+        # Tiered-storage residency + movement (storage/tiered.py), summed
+        # over every spill owner (in-flight rings + determinant tier).
+        # Zero when spilling is disabled; `clonos_tpu top` renders the
+        # spill.* suffix as its SPILL column.
+        if self.executor.spill_logs is not None:
+            g.gauge("spill.host-epochs",
+                    lambda: self.executor.spill_occupancy()["host_epochs"])
+            g.gauge("spill.disk-epochs",
+                    lambda: self.executor.spill_occupancy()["disk_epochs"])
+            g.gauge("spill.host-bytes",
+                    lambda: self.executor.spill_occupancy()["host_bytes"])
+            g.gauge("spill.disk-bytes",
+                    lambda: self.executor.spill_occupancy()["disk_bytes"])
+            g.gauge("spill.bytes-spilled",
+                    lambda: self.executor.spill_stats()
+                    .get("bytes_spilled", 0))
+            g.gauge("spill.bytes-refilled",
+                    lambda: self.executor.spill_stats()
+                    .get("bytes_refilled", 0))
         self.watchdog = met.LogOccupancyWatchdog(self.executor, g)
         # Per-mesh-shard health (mesh-sharded fused blocks): one gauge
         # triple per task-axis shard, fed from the executor's packed
@@ -817,6 +837,8 @@ class ClusterRunner:
             kw["spool_dir"] = os.path.join(cfg.get(D.CHECKPOINT_DIR),
                                            "spill")
             kw["spill_policy"] = cfg.get(D.INFLIGHT_SPILL_POLICY)
+            kw["spill_host_budget_epochs"] = cfg.get(
+                D.INFLIGHT_HOST_BUDGET_EPOCHS)
         if cfg.contains(D.CHECKPOINT_DIR):
             kw["checkpoint_dir"] = cfg.get(D.CHECKPOINT_DIR)
         if cfg.get(D.AUDIT_ENABLED):
@@ -913,20 +935,18 @@ class ClusterRunner:
 
         # The absolute superstep at the fence: the lean snapshot's ring
         # heads ARE step counts (one append per superstep). A job with
-        # no rings (single vertex, no edges) carries no such counter —
-        # silently fencing at step 0 would rebase a mid-run checkpoint
-        # to the beginning of time and replay from the wrong offset, so
-        # refuse anything past epoch 0 instead.
+        # no rings (single vertex, no edges) carries no such counter,
+        # but checkpoint cadence pins it anyway: checkpoint id e seals
+        # epochs 0..e, so its fence sits at exactly (e + 1) *
+        # steps_per_epoch supersteps — the same invariant `ring_heads[0]`
+        # encodes when rings exist (one append per superstep from step
+        # 0). Deriving it makes edge-less jobs bootstrappable past epoch
+        # 0 instead of refusing (ADVICE round 5: the old silent
+        # `global_step = 0` default replayed from the wrong offset).
         if ckpt.carry.ring_heads:
             fence = int(np.asarray(ckpt.carry.ring_heads[0]))
-        elif ckpt.checkpoint_id > 0:
-            raise rec.RecoveryError(
-                f"bootstrap_standby: checkpoint {ckpt.checkpoint_id} has "
-                f"no in-flight ring heads to derive the fence step from "
-                f"(edge-less job past epoch 0) — the fence cannot be "
-                f"reconstructed")
         else:
-            fence = spe
+            fence = (ckpt.checkpoint_id + 1) * spe
 
         # Steps replayed = sync-anchor count of the mirrored streams
         # (lockstep supersteps: every log advances together, and the
@@ -1301,6 +1321,11 @@ class ClusterRunner:
                     self.auditor.seal(dg)
                 with prof.section("ledger-write"):
                     self.coordinator.record_ledger(dg.to_entry())
+                if self.executor.spill_logs is not None:
+                    # Segment index entries inherit the ledger's channel
+                    # fingerprints — spill/refill round-trips become
+                    # audit-verifiable (storage/tiered.py docstring).
+                    self.executor.attach_spill_digests(closed, dg)
                 self.epoch_tracker.notify_epoch_sealed(closed, dg)
                 self._m_audit_sealed.inc()
             # Checkpoint at the fence: the lean fence snapshot (op state
@@ -2348,6 +2373,12 @@ class ClusterRunner:
                         have = hi
                     if have >= boundary:
                         break
+            except (SegmentCorruptError, StorageError) as e:
+                # Torn/corrupt/missing segment on refill: surface as a
+                # labeled recovery failure, never as garbage replay bytes
+                # (satellite: spill-file durability).
+                raise rec.RecoveryError(
+                    f"vertex {src_vid}: tiered refill failed — {e}") from e
             finally:
                 it.close()
         if have < required_end:

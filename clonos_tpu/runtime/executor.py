@@ -42,6 +42,7 @@ time/RNG arrays in one transfer and reads sink batches out.
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import time as _time
 from functools import partial
 from typing import (Any, Dict, List, NamedTuple, Optional, Sequence,
@@ -595,6 +596,7 @@ class LocalExecutor:
                  mesh: Optional[jax.sharding.Mesh] = None,
                  spool_dir: Optional[str] = None,
                  spill_policy: str = ifl.SpillPolicy.EAGER,
+                 spill_host_budget_epochs: int = 2,
                  block_steps: Optional[int] = None,
                  replication_factor: int = -1,
                  seed: int = 0, logical_time: bool = False):
@@ -714,15 +716,36 @@ class LocalExecutor:
         # Host-side spill owners, one per ring vertex (None = disabled).
         self.spill_policy = spill_policy
         self.spill_logs: Optional[List[ifl.SpillingInFlightLog]] = None
+        #: determinant-log tier (storage/tiered.py): sealed epochs of every
+        #: stacked causal log spill through the same host→disk tiers as the
+        #: in-flight rings, so replication depth is no longer HBM-bounded.
+        self.det_store = None
         #: per-ring epochs deferred by the AVAILABILITY policy, awaiting
         #: either a later spill (before a wrap) or truncation.
         self._pending_spill: List[List[Tuple[int, int, int]]] = [
             [] for _ in self.compiled.ring_vertices]
         if spool_dir is not None:
+            from clonos_tpu.storage import TieredEpochStore
             self.spill_logs = [
-                ifl.SpillingInFlightLog(spool_dir, edge_id=vid,
-                                        policy=spill_policy)
+                ifl.SpillingInFlightLog(
+                    spool_dir, edge_id=vid, policy=spill_policy,
+                    host_budget_epochs=spill_host_budget_epochs)
                 for vid in self.compiled.ring_vertices]
+            self.det_store = TieredEpochStore(
+                spool_dir, "dets",
+                durable=spill_policy != ifl.SpillPolicy.DISABLED,
+                host_budget_epochs=spill_host_budget_epochs)
+            # Static bound for the fused epoch-window gather: the sync
+            # block stream is DETS_PER_STEP rows/step; async appends
+            # (timers, sources) ride on top, so leave headroom and fall
+            # back to the exact host extraction when a hot epoch blows
+            # past it (_spill_epoch checks counts against this).
+            self._det_window_rows = min(
+                self.compiled.log_capacity,
+                steps_per_epoch * DETS_PER_STEP * 2 + 64)
+            self._jit_det_window = jax.jit(
+                partial(clog.epoch_row_windows,
+                        max_rows=self._det_window_rows))
         # Anti-alias the initial carry: constructors (and XLA CSE inside
         # jitted init paths) can hand several leaves the same underlying
         # buffer, which the donated block program rejects ("donate the
@@ -948,9 +971,12 @@ class LocalExecutor:
                 if n > 0:
                     self._pending_spill[i].append((epoch, start, n))
             elif n > 0:
+                # Device arrays go straight to the spill owner: the
+                # device→host copy happens on its writer thread, overlapped
+                # with the next epoch's compute (the slice result is a
+                # fresh buffer, so the roll's donation cannot alias it).
                 batch, count, s0 = ifl.slice_steps(el, start, n)
-                self.spill_logs[i].spill_epoch(epoch, int(s0),
-                                               jax.device_get(batch))
+                self.spill_logs[i].spill_epoch(epoch, int(s0), batch)
             # Retroactive flush: anything a wrap could reach within the
             # next epoch's appends must leave the ring now.
             danger = head + self.steps_per_epoch - el.ring_steps
@@ -963,11 +989,38 @@ class LocalExecutor:
                         f"(AVAILABILITY policy deferred too long)")
                 if s < danger:
                     batch, count, s0 = ifl.slice_steps(el, s, m)
-                    self.spill_logs[i].spill_epoch(e, int(s0),
-                                                   jax.device_get(batch))
+                    self.spill_logs[i].spill_epoch(e, int(s0), batch)
                 else:
                     keep.append((e, s, m))
             self._pending_spill[i] = keep
+        if self.det_store is not None:
+            self._spill_det_epoch(epoch)
+
+    def _spill_det_epoch(self, epoch: int) -> None:
+        """Evict the just-closed epoch's determinant windows (every stacked
+        log, one fused gather) into the tiered store — called before the
+        roll stamps the next epoch's start, so each window is
+        ``[epoch_start, head)``, exactly :meth:`epoch_window`'s slice."""
+        me = self.compiled.max_epochs
+        rows, counts, starts = self._jit_det_window(
+            self.carry.logs, epoch % me)
+        counts_h = np.asarray(counts)
+        starts_h = np.asarray(starts)
+        n = int(counts_h.max()) if counts_h.size else 0
+        if n > self._det_window_rows:
+            # Async-heavy epoch blew past the static gather bound: degrade
+            # to the exact host extraction rather than truncate rows.
+            win = self.epoch_window(epoch)["logs"]
+            padded = np.zeros((len(win), max(n, 1), det.NUM_LANES),
+                              np.int32)
+            for flat, r in win.items():
+                padded[flat, :r.shape[0]] = r
+            rows = padded
+        elif n < self._det_window_rows:
+            rows = rows[:, :max(n, 1)]   # trim ring-garbage padding
+        self.det_store.put(
+            epoch, int(starts_h.min()) if starts_h.size else 0,
+            {"rows": rows, "counts": counts_h, "starts": starts_h})
 
     def notify_checkpoint_complete(self, epoch: int) -> None:
         """Truncate determinant + in-flight logs for epochs <= ``epoch``."""
@@ -984,6 +1037,8 @@ class LocalExecutor:
             if self.spill_logs is not None:
                 for sl in self.spill_logs:
                     sl.truncate(epoch)
+            if self.det_store is not None:
+                self.det_store.truncate(epoch)
         for i, pend in enumerate(self._pending_spill):
             self._pending_spill[i] = [(e, s, m) for (e, s, m) in pend
                                       if e > epoch]
@@ -991,6 +1046,71 @@ class LocalExecutor:
                                if k[1] > epoch}
         self.async_counts = {k: v for k, v in self.async_counts.items()
                              if k[1] > epoch}
+
+    # --- tiered-storage surface (storage/tiered.py) --------------------------
+
+    def _tier_stores(self):
+        out = []
+        if self.spill_logs is not None:
+            out.extend(sl.store for sl in self.spill_logs)
+        if self.det_store is not None:
+            out.append(self.det_store)
+        return out
+
+    def attach_spill_digests(self, epoch: int, dg) -> None:
+        """Stamp the sealed epoch's audit fingerprints onto its spilled
+        tiers: each ring segment carries its ``ring/v<vid>`` channel
+        chain, the determinant segment one fold over the ``log/<flat>``
+        chains — the SAME digests the ledger entry pins, so a
+        spill/refill round-trip is audit-verifiable for free."""
+        if self.spill_logs is not None:
+            for i, vid in enumerate(self.compiled.ring_vertices):
+                ch = dg.channels.get(f"ring/v{vid}")
+                if ch is not None:
+                    self.spill_logs[i].attach_digest(epoch, ch[1].hex())
+        if self.det_store is not None:
+            h = hashlib.blake2b(digest_size=8)
+            for name in sorted(dg.channels):
+                if name.startswith("log/"):
+                    _, state = dg.channels[name]
+                    h.update(name.encode() + b"\x00" + state)
+            self.det_store.attach_digest(epoch, h.hexdigest())
+
+    def det_rows_for_epoch(self, flat: int, epoch: int) -> np.ndarray:
+        """Refill one subtask's determinant-row window for a spilled
+        epoch from whichever tier holds it — bit-identical to the
+        ``epoch_window(epoch)["logs"][flat]`` slice taken at the seal
+        (the spilled-determinant acceptance test pins this)."""
+        if self.det_store is None:
+            raise RuntimeError("determinant tier disabled (no spool_dir)")
+        _, arrs = self.det_store.load_epoch(epoch)
+        c = int(np.asarray(arrs["counts"])[flat])
+        return np.ascontiguousarray(np.asarray(arrs["rows"])[flat, :c])
+
+    def spill_occupancy(self) -> Dict[str, int]:
+        """Tier residency summed across every spill owner (rings + dets)
+        — the ``spill.*`` occupancy gauges."""
+        agg = {"host_epochs": 0, "host_bytes": 0,
+               "disk_epochs": 0, "disk_bytes": 0}
+        for st in self._tier_stores():
+            for k, v in st.occupancy().items():
+                agg[k] += v
+        return agg
+
+    def spill_stats(self) -> Dict[str, Any]:
+        """Cumulative spill/refill movement counters summed across
+        stores (bench ``--spill`` fields)."""
+        agg: Dict[str, Any] = {}
+        for st in self._tier_stores():
+            for k, v in st.stats().items():
+                agg[k] = agg.get(k, 0) + v
+        return agg
+
+    def drain_spill(self) -> None:
+        """Block until every queued segment write is durable (tests,
+        pre-kill quiesce in soak)."""
+        for st in self._tier_stores():
+            st.drain()
 
     def epoch_window(self, epoch: int) -> Dict[str, Any]:
         """Host snapshot of one CLOSED epoch's causal surface — the single

@@ -11,7 +11,11 @@ Grammar (one event per line or ``;``-separated; ``#`` comments)::
     at 5s kill 1,9,17                 # cascading SIGKILL: flat subtasks
     at 12s gray 2 delay=50ms for 3s   # slow-worker gray failure
     at 20s leader-loss hold=1s        # rival claims the lease for 1s
-    at 30s stall delay=200ms for 2s   # checkpoint-storage write stall
+    at 30s stall delay=200ms for 2s   # checkpoint-storage + spill-
+                                      # segment write stall
+    at 35s backlog for 4s             # suppress checkpoint completion:
+                                      # replay backlog grows past the
+                                      # device ring into the spill tiers
     at 40s nondet                     # unlogged value perturbation
                                       # (audit bait — MUST fail the run)
 
@@ -30,8 +34,12 @@ import numpy as np
 
 #: every fault kind the harness knows how to apply. ``nondet`` is the
 #: audit bait: an unlogged perturbation that every structural check
-#: passes and only the epoch-digest diff catches.
-FAULT_KINDS = ("kill", "gray", "leader-loss", "stall", "nondet")
+#: passes and only the epoch-digest diff catches. ``backlog`` starves
+#: checkpoint completion so truncation stops and the replay backlog
+#: spills past the device ring into the host/disk tiers
+#: (storage/tiered.py) — the long-backlog disk-replay scenario.
+FAULT_KINDS = ("kill", "gray", "leader-loss", "stall", "nondet",
+               "backlog")
 
 
 def _dur(tok: str) -> float:
@@ -75,6 +83,8 @@ class ChaosEvent:
             parts.append(",".join(str(t) for t in self.targets))
         if self.kind in ("gray", "stall"):
             parts.append(f"delay={_fmt_dur(self.delay_s)}")
+            parts.append(f"for {_fmt_dur(self.duration_s)}")
+        if self.kind == "backlog":
             parts.append(f"for {_fmt_dur(self.duration_s)}")
         if self.kind == "leader-loss" and self.hold_s:
             parts.append(f"hold={_fmt_dur(self.hold_s)}")
@@ -127,6 +137,9 @@ def _parse_event(line: str) -> ChaosEvent:
     if kind in ("gray", "stall") and (delay_s <= 0 or duration_s <= 0):
         raise ValueError(f"chaos event {line!r}: {kind} needs "
                          f"delay=<d> for <d>")
+    if kind == "backlog" and duration_s <= 0:
+        raise ValueError(f"chaos event {line!r}: backlog needs "
+                         f"for <duration>")
     if kind == "gray" and len(targets) != 1:
         raise ValueError(f"chaos event {line!r}: gray takes exactly one "
                          f"target")
@@ -255,6 +268,10 @@ class ChaosSchedule:
                 events.append(ChaosEvent(
                     float(at_s), "stall",
                     delay_s=round(float(rng.uniform(0.1, 0.3)), 3),
+                    duration_s=round(float(rng.uniform(1.0, 3.0)), 2)))
+            elif kind == "backlog":
+                events.append(ChaosEvent(
+                    float(at_s), "backlog",
                     duration_s=round(float(rng.uniform(1.0, 3.0)), 2)))
             else:                       # nondet
                 events.append(ChaosEvent(float(at_s), "nondet"))

@@ -62,6 +62,12 @@ class SoakHarness:
         self.gray_delay_s = 0.0
         self._stall_orig = None
         self._stall_until = 0.0
+        #: soak-clock instant until which checkpoint completion is
+        #: suppressed (the `backlog` fault): truncation stops, the
+        #: replay backlog grows past the device rings into the spill
+        #: tiers (storage/tiered.py), and any recovery in that window
+        #: replays from host/disk segments.
+        self.backlog_until = 0.0
         #: set on every applied fault; the driver runs an audit check at
         #: the next fence and clears it
         self.audit_pending = False
@@ -143,8 +149,26 @@ class SoakHarness:
             return orig(*a, **k)
 
         storage.write = stalled_write
+        # The same fault tortures the spill path: segment writes on the
+        # tiered stores' writer threads sleep too. The fence must NOT
+        # stretch by this (spilling is asynchronous — the soak stall
+        # scenario pins exactly that), and replay through the stalled
+        # tier must still round-trip bit-identically.
+        for st in self.runner.executor._tier_stores():
+            st.write_delay_s = max(st.write_delay_s, delay)
         self._stall_until = max(self._stall_until,
                                 now_s + event.duration_s)
+
+    def _apply_backlog(self, event: ChaosEvent, now_s: float) -> None:
+        # Long-backlog torture: the driver suppresses checkpoint
+        # completion while active (see _run_paced), so truncation stops
+        # and sealed epochs pile up past device ring capacity — replay
+        # after this window MUST refill from the host/disk tiers.
+        self.backlog_until = max(self.backlog_until,
+                                 now_s + event.duration_s)
+
+    def backlog_active(self, now_s: float) -> bool:
+        return now_s < self.backlog_until
 
     def _apply_nondet(self, event: ChaosEvent, now_s: float) -> None:
         # Unlogged value perturbation on-device (audit bait): occupied
@@ -176,8 +200,14 @@ class SoakHarness:
         if self._stall_orig is not None and now_s >= self._stall_until:
             self.runner.coordinator.storage.write = self._stall_orig
             self._stall_orig = None
+            for st in self.runner.executor._tier_stores():
+                st.write_delay_s = 0.0
             self.faults_survived += 1
             self.tracer.event("soak.chaos.expired", kind="stall")
+        if self.backlog_until and now_s >= self.backlog_until:
+            self.backlog_until = 0.0
+            self.faults_survived += 1
+            self.tracer.event("soak.chaos.expired", kind="backlog")
 
     def audit_check(self) -> List[str]:
         """Advance the control twin to the soak runner's last sealed
@@ -398,10 +428,23 @@ class SoakDriver:
                 kill_armed = False
             # -- epoch fence
             if ex.step_in_epoch >= spe:
+                # backlog fault: suppress completion (truncation stops,
+                # the spill tiers absorb the sealed epochs), but a
+                # deferred kill's fence still completes — the kill
+                # invariant (no pendings at kill time) wins.
                 complete = (force_complete
-                            or fences % cfg.complete_every == 0)
+                            or (fences % cfg.complete_every == 0
+                                and not h.backlog_active(now_s)))
                 r.run_epoch(complete_checkpoint=complete)
                 fences += 1
+                if not complete and h.backlog_active(now_s):
+                    # abandon immediately: suppressed fences must leave
+                    # nothing pending either, or a kill in the backlog
+                    # window appends IGNORE determinants the control
+                    # twin never sees (digest divergence by design,
+                    # not by bug)
+                    r.coordinator.discard_pending_through(
+                        ex.epoch_id - 1)
                 if complete:
                     # abandon OLDER skipped fences' checkpoints: a
                     # completing fence must leave nothing pending, or

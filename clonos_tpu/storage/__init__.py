@@ -1,0 +1,31 @@
+"""Tiered sealed-epoch storage: device ring → host buffer → disk segments.
+
+The paper's in-flight log is "in memory, spillable to disk" (PAPER.md
+core idea 3). This package is the spill fabric shared by the in-flight
+rings (inflight/log.py) and the determinant logs (causal/log.py): the
+hot tier stays a device tensor ring (the unchanged fast path); sealed
+epochs are evicted asynchronously to a host staging buffer and persisted
+as immutable checksummed segment files; recovery refills transparently
+from whichever tier holds each epoch.
+
+- :mod:`segment` — the on-disk unit: one sealed epoch, one file, one
+  blake2b checksum, atomically replaced into place; a JSONL segment
+  index with the shared torn-tail convention (utils/jsonl.py).
+- :mod:`tiered` — :class:`TieredEpochStore`, the host-buffer +
+  disk-segment owner with an asynchronous double-buffered writer,
+  tier-occupancy accounting, spill/refill bandwidth counters, and audit
+  digests attached to each sealed segment.
+"""
+
+from clonos_tpu.storage.segment import (SegmentCorruptError, read_segment,
+                                        segment_checksum, write_segment)
+from clonos_tpu.storage.tiered import StorageError, TieredEpochStore
+
+__all__ = [
+    "SegmentCorruptError",
+    "StorageError",
+    "TieredEpochStore",
+    "read_segment",
+    "segment_checksum",
+    "write_segment",
+]

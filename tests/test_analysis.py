@@ -390,6 +390,25 @@ def test_static_cost_model_scales_linearly():
     assert m1["replica_bytes_per_epoch"] == 0
 
 
+def test_static_cost_model_spill_lanes():
+    """``spill=True`` adds the tiered-storage lanes (d2h staging + disk
+    write, both sized at the spilled payload) and raises the predicted
+    ft-fraction; off, the lanes are present but zero (stable schema for
+    BENCH json diffing)."""
+    census = run_analysis().census
+    base = static_cost_model(census, steps_per_epoch=100, subtasks=8,
+                             records_per_step=64, ring_vertices=2)
+    on = static_cost_model(census, steps_per_epoch=100, subtasks=8,
+                           records_per_step=64, ring_vertices=2,
+                           spill=True)
+    assert base["spill_d2h_bytes_per_epoch"] == 0
+    assert base["spill_disk_bytes_per_epoch"] == 0
+    assert on["spill_d2h_bytes_per_epoch"] > 0
+    assert on["spill_disk_bytes_per_epoch"] == \
+        on["spill_d2h_bytes_per_epoch"]
+    assert on["ft_fraction_static"] > base["ft_fraction_static"]
+
+
 # --- repo gate -----------------------------------------------------------
 
 def test_repo_analyzes_clean(monkeypatch):

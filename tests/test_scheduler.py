@@ -189,13 +189,13 @@ def test_endpoint_rejects_stale_and_forged_fencing_tokens(tmp_path):
 # --- ring-less bootstrap fence (satellite) -----------------------------------
 
 
-def test_bootstrap_standby_refuses_ringless_fence_past_epoch_zero(tmp_path):
-    """An edge-less job's lean snapshot carries no ring heads, so the
-    absolute fence step of a checkpoint past epoch 0 cannot be derived;
-    silently fencing at 0 would replay from the wrong offsets — the
-    rebuild must refuse loudly instead (RecoveryError)."""
+def test_bootstrap_standby_derives_ringless_fence_from_cadence(tmp_path):
+    """An edge-less job's lean snapshot carries no ring heads, but
+    checkpoint cadence pins the fence anyway: checkpoint id e seals
+    epochs 0..e, so its fence is exactly (e + 1) * steps_per_epoch. The
+    rebuild must derive that — never silently fence at step 0 (which
+    would replay from the wrong offsets)."""
     from clonos_tpu.api.environment import StreamEnvironment
-    from clonos_tpu.causal.recovery import RecoveryError
     from clonos_tpu.runtime.cluster import ClusterRunner
 
     env = StreamEnvironment(name="ringless", num_key_groups=8)
@@ -212,10 +212,13 @@ def test_bootstrap_standby_refuses_ringless_fence_past_epoch_zero(tmp_path):
     cap = np.asarray(logs.rows).shape[1]
     pos = np.arange(tail, head) & (cap - 1)
     mirror_rows = {0: (np.asarray(logs.rows)[0][pos], tail)}
-    with pytest.raises(RecoveryError, match="no in-flight ring heads"):
-        ClusterRunner.bootstrap_standby(job, ck, mirror_rows,
-                                        steps_per_epoch=4, log_capacity=256,
-                                        max_epochs=8, seed=2)
+    rebuilt, report = ClusterRunner.bootstrap_standby(
+        job, ck, mirror_rows, steps_per_epoch=4, log_capacity=256,
+        max_epochs=8, seed=2)
+    # 3 completed checkpoints (ids 0..2) -> fence at step (2+1)*4 = 12;
+    # everything at/below the fence rode the checkpoint, nothing replays.
+    assert rebuilt.global_step == 12 + report.steps_replayed
+    assert rebuilt.executor.epoch_id == 3 + report.steps_replayed // 4
 
 
 # --- cross-worker edge wire, in-process --------------------------------------

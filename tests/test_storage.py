@@ -1,0 +1,257 @@
+"""Tiered determinant & in-flight storage (clonos_tpu/storage/):
+device ring -> host buffer -> checksummed disk segments.
+
+The acceptance pairs:
+
+- a recovery whose replay backlog exceeds device ring capacity succeeds
+  by refilling from the host/disk tiers, bit-identical under the audit
+  ledger (``diff_ledgers == []`` vs a no-spill control run);
+- a torn/truncated/bit-rotted segment is REFUSED with a labeled
+  :class:`SegmentCorruptError`, and through the recovery path surfaces
+  as a :class:`RecoveryError` — never as silently wrong replay bytes.
+"""
+
+import glob
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from clonos_tpu.obs.digest import diff_ledgers
+from clonos_tpu.storage import (SegmentCorruptError, StorageError,
+                                TieredEpochStore, read_segment,
+                                segment_checksum, write_segment)
+
+
+def _arrays(seed):
+    rng = np.random.RandomState(seed)
+    return {
+        "rows": rng.randint(0, 1 << 30, size=(4, 6, 3)).astype(np.int32),
+        "weights": rng.rand(8).astype(np.float32),
+        "valid": (rng.rand(5, 2) > 0.5),
+        "empty": np.zeros((0, 7), np.int64),
+    }
+
+
+# --- segment container -------------------------------------------------------
+
+
+def test_segment_raw_container_roundtrip(tmp_path):
+    path = str(tmp_path / "e0.seg")
+    arrays = _arrays(0)
+    nbytes, checksum = write_segment(path, 37, arrays)
+    assert nbytes == os.path.getsize(path)
+    with open(path, "rb") as f:
+        assert segment_checksum(f.read()) == checksum
+    start, out = read_segment(path, checksum, "t:epoch0")
+    assert start == 37
+    assert set(out) == set(arrays)
+    for k, v in arrays.items():
+        assert out[k].dtype == v.dtype and out[k].shape == v.shape
+        np.testing.assert_array_equal(out[k], v)
+
+
+def test_corrupt_or_torn_segment_refused_with_label(tmp_path):
+    path = str(tmp_path / "e1.seg")
+    _, checksum = write_segment(path, 0, _arrays(1))
+    data = open(path, "rb").read()
+    # Bit rot: one flipped byte in the middle of the payload.
+    rot = bytearray(data)
+    rot[len(rot) // 2] ^= 0x40
+    open(path, "wb").write(bytes(rot))
+    with pytest.raises(SegmentCorruptError,
+                       match=r"rotted:epoch1.*checksum mismatch"):
+        read_segment(path, checksum, "rotted:epoch1")
+    # Torn tail: a SIGKILLed writer's truncated file.
+    open(path, "wb").write(data[:len(data) - 11])
+    with pytest.raises(SegmentCorruptError, match="refill refused"):
+        read_segment(path, checksum, "torn:epoch1")
+    # Missing entirely.
+    os.remove(path)
+    with pytest.raises(SegmentCorruptError, match="unreadable"):
+        read_segment(path, checksum, "gone:epoch1")
+
+
+# --- tiered store ------------------------------------------------------------
+
+
+def test_tiered_roundtrip_budget_demotion_and_truncate(tmp_path):
+    store = TieredEpochStore(str(tmp_path), "dets",
+                             host_budget_epochs=1)
+    put = {e: _arrays(e) for e in range(4)}
+    for e, arrs in put.items():
+        store.put(e, e * 10, arrs)
+    store.drain()
+    occ = store.occupancy()
+    # Budget 1: only the newest epoch keeps a host copy; all four are
+    # durable on disk.
+    assert occ["host_epochs"] == 1 and occ["disk_epochs"] == 4
+    assert occ["disk_bytes"] > occ["host_bytes"] > 0
+    # Oldest epoch refills from disk, newest from host — bit-identical
+    # either way.
+    start, out = store.load_epoch(0)
+    assert start == 0
+    np.testing.assert_array_equal(out["rows"], put[0]["rows"])
+    start, out = store.load_epoch(3)
+    assert start == 30
+    np.testing.assert_array_equal(out["weights"], put[3]["weights"])
+    stats = store.stats()
+    assert stats["disk_hits"] == 1 and stats["host_hits"] == 1
+    assert stats["bytes_refilled"] > 0
+    assert stats["segments_written"] == 4 and stats["bytes_spilled"] > 0
+    # Checkpoint completion truncates tier-wide: host dict AND files.
+    store.truncate(2)
+    store.drain()
+    assert store.retained_epochs() == [3]
+    assert not os.path.exists(store.segment_path(0))
+    assert not os.path.exists(store.segment_path(2))
+    assert os.path.exists(store.segment_path(3))
+    with pytest.raises(StorageError, match="dets:epoch1.*not retained"):
+        store.load_epoch(1)
+    store.close()
+
+
+def test_open_index_fresh_process_refill_and_torn_tail(tmp_path):
+    store = TieredEpochStore(str(tmp_path), "edge0",
+                             host_budget_epochs=0)
+    put = {e: _arrays(10 + e) for e in range(3)}
+    for e, arrs in put.items():
+        store.put(e, e * 4, arrs)
+        store.attach_digest(e, f"d{e:016x}")
+    store.truncate(0)                  # completed checkpoint drops e0
+    store.drain()
+    store.close()
+    # A SIGKILLed writer leaves a torn final index line: dropped
+    # silently, like every other append log (utils/jsonl.py).
+    with open(os.path.join(str(tmp_path), "edge0.index.jsonl"), "a") as f:
+        f.write('{"kind":"segm')
+    fresh = TieredEpochStore.open_index(str(tmp_path), "edge0")
+    # The truncate record held: epoch 0 must NOT resurrect.
+    assert fresh.retained_epochs() == [1, 2]
+    assert fresh.epoch_digest(1) == "d" + format(1, "016x")
+    start, out = fresh.load_epoch(2)   # disk-tier read, checksum-gated
+    assert start == 8
+    np.testing.assert_array_equal(out["rows"], put[2]["rows"])
+    assert fresh.stats()["disk_hits"] == 1
+    fresh.close()
+
+
+# --- cluster integration -----------------------------------------------------
+
+
+def _build_job():
+    from clonos_tpu.api.environment import StreamEnvironment
+    env = StreamEnvironment(name="tiered", num_key_groups=8)
+    (env.synthetic_source(vocab=13, batch_size=4, parallelism=2)
+        .key_by().window_count(num_keys=13, window_size=1 << 30)
+        .sink())
+    return env.build()
+
+
+def _runner(tmp_path=None, ring_steps=8, budget=0):
+    from clonos_tpu.runtime.cluster import ClusterRunner
+    kw = dict(steps_per_epoch=4, log_capacity=1 << 9, max_epochs=16,
+              inflight_ring_steps=ring_steps, seed=11,
+              logical_time=True, audit=True)
+    if tmp_path is not None:
+        kw.update(spool_dir=str(tmp_path),
+                  spill_host_budget_epochs=budget)
+    return ClusterRunner(_build_job(), **kw)
+
+
+def _backlog(runner, pending=3):
+    runner.run_epoch(complete_checkpoint=True)     # restore point
+    for _ in range(pending):
+        runner.run_epoch(complete_checkpoint=False)
+
+
+def test_deep_backlog_recovery_refills_from_disk_bit_identical(tmp_path):
+    """THE tentpole acceptance: 12 backlog steps against an 8-step
+    device ring — the leading epoch's in-flight batches exist only in
+    the tiers (host budget 0 forces the DISK leg) and recovery is
+    audit-verified bit-identical against a no-spill control whose ring
+    held everything."""
+    control = _runner(None, ring_steps=32)         # ring holds the span
+    _backlog(control)
+    r = _runner(tmp_path, ring_steps=8, budget=0)  # ring holds 2 epochs
+    _backlog(r)
+    r.executor.drain_spill()                       # segments durable
+    r.inject_failure([3])                          # window subtask 1
+    report = r.recover()
+    assert report.steps_replayed == 12 > 8         # beyond the ring
+    assert r.executor.spill_stats()["disk_hits"] > 0
+    hi = r.auditor.last_epoch
+    expected = [e for e in control.auditor.ledger() if e["epoch"] <= hi]
+    actual = [e for e in r.auditor.ledger() if e["epoch"] <= hi]
+    assert expected and diff_ledgers(expected, actual) == []
+
+
+def test_torn_segment_tail_surfaces_as_labeled_recovery_error(tmp_path):
+    """Recovery that needs a tier refill across a torn segment must
+    fail loudly with the storage label — never replay garbage."""
+    from clonos_tpu.causal.recovery import RecoveryError
+    r = _runner(tmp_path, ring_steps=8, budget=0)
+    _backlog(r)
+    r.executor.drain_spill()
+    # Tear every ring-edge segment of the epoch that outran the ring
+    # (epoch 1: its 4 steps sit below head - ring_steps at the kill).
+    torn = [p for p in glob.glob(os.path.join(str(tmp_path),
+                                              "edge*_epoch1.seg"))]
+    assert torn, "expected spilled ring segments for epoch 1"
+    for p in torn:
+        data = open(p, "rb").read()
+        open(p, "wb").write(data[:len(data) // 2])
+    r.inject_failure([3])
+    with pytest.raises(RecoveryError,
+                       match=r"tiered refill failed.*epoch1"):
+        r.recover()
+
+
+def test_det_store_refill_bit_identical_to_seal_window(tmp_path):
+    """The determinant tier's promise: ``det_rows_for_epoch`` returns
+    exactly the ``epoch_window(e)["logs"][flat]`` slice that was sealed
+    (and digested) at the fence."""
+    r = _runner(tmp_path, ring_steps=32, budget=0)
+    _backlog(r, pending=2)
+    r.executor.drain_spill()
+    ex = r.executor
+    assert sorted(ex.det_store.retained_epochs()) == [1, 2]
+    for epoch in (1, 2):
+        win = ex.epoch_window(epoch)["logs"]
+        for flat, rows in win.items():
+            got = ex.det_rows_for_epoch(flat, epoch)
+            np.testing.assert_array_equal(got, np.asarray(rows))
+    # The digest attached at the seal rides the index (audit linkage).
+    assert ex.det_store.epoch_digest(2)
+
+
+def test_spill_occupancy_and_stats_aggregate_across_stores(tmp_path):
+    r = _runner(tmp_path, ring_steps=8, budget=1)
+    _backlog(r, pending=2)
+    r.executor.drain_spill()
+    occ = r.executor.spill_occupancy()
+    assert occ["disk_epochs"] > 0 and occ["disk_bytes"] > 0
+    assert occ["host_epochs"] > 0                  # budget keeps newest
+    stats = r.executor.spill_stats()
+    # Written >= resident: the completed checkpoint truncated epoch 0's
+    # segments after they were written.
+    assert stats["segments_written"] >= occ["disk_epochs"]
+    assert stats["bytes_spilled"] >= occ["disk_bytes"]
+
+
+# --- chaos DSL: the backlog fault --------------------------------------------
+
+
+def test_chaos_backlog_event_roundtrip_and_seeding():
+    from clonos_tpu.soak.chaos import ChaosSchedule, parse_schedule
+    sched = parse_schedule("at 35s backlog for 4s")
+    (ev,) = sched.events
+    assert ev.kind == "backlog" and ev.duration_s == 4.0
+    assert parse_schedule(ev.to_text()) == sched   # byte round-trip
+    with pytest.raises(ValueError, match="backlog needs"):
+        parse_schedule("at 1s backlog")
+    seeded = ChaosSchedule.seeded(7, 60.0, targets=[1],
+                                  kinds=("kill", "backlog"))
+    assert "backlog" in seeded.kinds()
+    assert parse_schedule(seeded.to_text()) == seeded
