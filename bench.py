@@ -868,13 +868,23 @@ def main(jobs=None, multichip=None, soak=None, ablate=False,
     # backlog must not double HBM — the ring holds precisely the
     # un-truncated window recovery can need.
     span = max(FILL_EPOCHS * STEPS_PER_EPOCH, 2)
+    # Persistent compile cache (utils/compile_cache.py): the prewarmed
+    # recovery programs + AOT first-step executable survive process
+    # restarts, so a re-run of this bench (and a restarted standby in
+    # deployment) pays near-zero prewarm compile. Opt out with
+    # BENCH_COMPILE_CACHE="".
+    cache_dir = os.environ.get(
+        "BENCH_COMPILE_CACHE",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     ".jax_cache"))
     runner = ClusterRunner(job, steps_per_epoch=STEPS_PER_EPOCH,
                            log_capacity=cap, max_epochs=16,
                            inflight_ring_steps=1 << (span - 1).bit_length(),
                            recovery_block_steps=8192,
                            block_steps=1024,
                            latency_marker_every=64,
-                           seed=7)
+                           seed=7,
+                           compile_cache_dir=cache_dir or None)
 
     t_warm0 = time.monotonic()
     runner.run_epoch(complete_checkpoint=True)    # epoch 0: restore point
@@ -939,15 +949,39 @@ def main(jobs=None, multichip=None, soak=None, ablate=False,
     # the full protocol (determinant fetch, input reconstruction, replay,
     # verify, patch, replica rebuild) on prewarmed programs. Min sheds
     # tunnel-latency noise; the mean is reported alongside (the honest
-    # number a noisy link delivers).
-    warm_recovery_runs = []
+    # number a noisy link delivers). Phases and the headline come from
+    # the SAME run and statistic: the best run's own report feeds
+    # recovery_phase_ms (BENCH_r05 mixed the cold run's breakdown with
+    # the warm minimum, so sub-phases summed past the headline).
+    warm_runs = []                                # (seconds, report)
     for _ in range(3):
         runner.inject_failure([failed_flat])
         t2 = time.monotonic()
-        runner.recover()
+        rep_w = runner.recover()
         device_sync(runner.executor.carry)
-        warm_recovery_runs.append(time.monotonic() - t2)
-    warm_recovery_s = min(warm_recovery_runs)
+        warm_runs.append((time.monotonic() - t2, rep_w))
+    warm_recovery_s, warm_report = min(warm_runs, key=lambda sr: sr[0])
+    warm_recovery_runs = [s for s, _ in warm_runs]
+
+    # Bit-identity of the overlapped pipeline vs a strictly sequential
+    # control: digest the live state over the replayed window after the
+    # overlapped runs, recover the same failure once more with
+    # overlap_finalize=False (the pre-PR12 ordering), digest again, and
+    # diff. An empty diff says the overlap changed WHEN finalize work
+    # ran, not WHAT state the job resumed on.
+    from clonos_tpu.causal.recovery import AuditValidator
+    from clonos_tpu.obs.digest import diff_ledgers
+    audit_epochs = list(range(warm_report.from_epoch,
+                              runner.executor.epoch_id))
+    _val = AuditValidator(runner.executor, [])
+    entries_overlap = _val.recompute_entries(audit_epochs)
+    runner.inject_failure([failed_flat])
+    t2 = time.monotonic()
+    runner.recover(overlap_finalize=False)
+    device_sync(runner.executor.carry)
+    seq_recovery_s = time.monotonic() - t2
+    entries_seq = _val.recompute_entries(audit_epochs)
+    ledger_diff = diff_ledgers(entries_seq, entries_overlap)
 
     # Warm replay rate: re-run the device replay on the same plan (the cold
     # number includes XLA compilation of the replay scan; steady-state
@@ -978,6 +1012,11 @@ def main(jobs=None, multichip=None, soak=None, ablate=False,
         "recovery_time_warm_ms": round(warm_recovery_s * 1e3, 1),
         "recovery_time_warm_mean_ms": round(
             1e3 * sum(warm_recovery_runs) / len(warm_recovery_runs), 1),
+        "recovery_time_warm_sequential_ms": round(seq_recovery_s * 1e3, 1),
+        # diff_ledgers(sequential-control digests, overlapped digests)
+        # over the replayed epoch window — [] proves the overlapped
+        # recovery left bit-identical state.
+        "ledger_diff_vs_sequential_control": ledger_diff,
         "prewarm_standby_s": round(prewarm_s, 1),
         "failover_drill_s": round(drill_s, 1),
         "replay_time_warm_ms": round(warm_replay_s * 1e3, 1),
@@ -987,15 +1026,29 @@ def main(jobs=None, multichip=None, soak=None, ablate=False,
             report.records_replayed
             / (sum(warm_replay_runs) / len(warm_replay_runs))
             / JVM_BASELINE_RECORDS_PER_SEC, 3),
+        # Same-run statistic: the BEST warm run's own breakdown (its
+        # values sum to ~recovery_time_warm_ms), the per-phase mean
+        # across all warm runs, and the cold run's breakdown under its
+        # own explicitly-cold key.
         "recovery_phase_ms": {k: round(v, 1)
-                              for k, v in report.phase_ms.items()},
+                              for k, v in warm_report.phase_ms.items()},
+        "recovery_phase_mean_ms": {
+            k: round(sum(r.phase_ms.get(k, 0.0) for _s, r in warm_runs)
+                     / len(warm_runs), 1)
+            for k in sorted({k for _s, r in warm_runs for k in r.phase_ms})},
+        "recovery_phase_cold_ms": {k: round(v, 1)
+                                   for k, v in report.phase_ms.items()},
         # The finalize mystery, attributable: named sub-spans of the
         # finalize phase (barrier read, state verify, and — on standby
-        # bootstraps — rehydrate/reattach/reregister/recompile).
+        # bootstraps — rehydrate/reattach/reregister/recompile), plus
+        # finalize.overlap-saved: wall time the overlapped tail removed
+        # from the critical path (sum(sub-spans) - saved == finalize).
         "finalize_phase_ms": {k: round(v, 1)
-                              for k, v in report.phase_ms.items()
+                              for k, v in warm_report.phase_ms.items()
                               if k == "finalize"
                               or k.startswith("finalize.")},
+        "finalize_overlap_saved_ms": round(
+            warm_report.phase_ms.get("finalize.overlap-saved", 0.0), 1),
         "steps_replayed": report.steps_replayed,
         "records_replayed": report.records_replayed,
         "buffered_determinants_cluster": buffered,
@@ -1026,7 +1079,7 @@ def main(jobs=None, multichip=None, soak=None, ablate=False,
     # configs build theirs — two multi-GB carries do not coexist on one
     # chip (jax frees buffers on GC).
     import gc
-    del runner, report, mgr, replayer, result
+    del runner, report, mgr, replayer, result, warm_runs, warm_report
     gc.collect()
     # Secondary BASELINE configs (#4 cascading, #5 join + external-service
     # calls) and the determinant-sharing-depth trade-off sweep. Guarded by

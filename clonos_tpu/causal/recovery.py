@@ -128,6 +128,19 @@ class AuditValidator:
                         f"did not reproduce the original execution")
         return stats
 
+    def recompute_entries(self, epochs: Sequence[int]) -> List[dict]:
+        """Recompute the given epochs' digests from the CURRENT carry
+        and return them as ledger entries (``EpochDigest.to_entry``
+        dicts) WITHOUT validating against the persisted ledger — the
+        raw material for a ``diff_ledgers`` comparison between two
+        recovery modes (bench proves the overlapped finalize pipeline
+        bit-identical to a sequential-recovery control this way:
+        ``diff_ledgers(seq_entries, overlap_entries) == []``)."""
+        from clonos_tpu.obs import audit as _audit
+        return [_audit.digest_epoch_window(
+                    int(e), self.executor.epoch_window(int(e))).to_entry()
+                for e in epochs]
+
 
 @dataclasses.dataclass
 class ReplayPlan:

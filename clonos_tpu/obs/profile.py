@@ -160,7 +160,13 @@ class Profiler:
 
     def observe(self, name: str, dur_s: float, kind: str = FT) -> None:
         """Attribute an already-measured duration (the caller timed
-        it)."""
+        it). Durations derived by subtraction — the overlapped
+        recovery tail attributes ``finalize`` as window wall minus
+        audit, and overlap credits as span minus blocked-join — can go
+        epsilon-negative on coarse monotonic clocks; clamp at zero so
+        cumulative windows and histograms never run backwards."""
+        if dur_s < 0.0:
+            dur_s = 0.0
         group = None
         with self._lock:
             self._cum[name] = self._cum.get(name, 0.0) + dur_s

@@ -1329,6 +1329,45 @@ class LocalExecutor:
         return sum(v for (f, e), v in self.async_counts.items()
                    if f == flat_subtask and e >= from_epoch)
 
+    def install_replay_ledgers(self,
+                               roll_gap: Dict[Tuple[int, int], int],
+                               async_counts: Dict[Tuple[int, int], int]
+                               ) -> None:
+        """Merge externally re-derived roll-gap / async-row ledgers (the
+        standby-host bootstrap derives them from mirrored determinant
+        streams, possibly on a worker thread overlapped with replay).
+        One atomic-enough install point: callers must invoke this BEFORE
+        anything reads the ledgers — recovery's ``_patch`` reads
+        ``roll_gap_async`` when rebuilding epoch start offsets, so the
+        bootstrap joins its derivation thread at recovery's pre-patch
+        join point, not after replay."""
+        self.roll_gap_async.update(roll_gap)
+        self.async_counts.update(async_counts)
+
+    def first_step_inputs(self) -> BlockInputs:
+        """Zeroed host-fed inputs with the FIRST-STEP block program's
+        exact avals — what :func:`utils.compile_cache.
+        aot_lower_first_step` lowers against (shape/dtype is all
+        lowering reads; values never execute)."""
+        k = self.block_steps
+        return BlockInputs(times=jnp.zeros((k,), jnp.int32),
+                           rng_bits=jnp.zeros((k,), jnp.int32),
+                           epoch=jnp.zeros((), jnp.int32),
+                           step0=jnp.zeros((), jnp.int32), feeds=())
+
+    def fast_forward_host_rng(self, steps: int) -> None:
+        """Reset the host RNG to a fresh seeded stream and consume
+        exactly one per-step draw for ``steps`` supersteps — the rebuilt
+        standby's stream position then matches the never-failed run's,
+        so its continuation draws precisely what the original would
+        have. Replay reproduces the prefix from RECORDED rng
+        determinants without consuming the stream, hence the explicit
+        fast-forward. Thread-safe only while nothing else draws (true
+        during recovery: the replayer never touches the host RNG)."""
+        self._rng = np.random.RandomState(self._seed)
+        for _ in range(steps):
+            self._rng.randint(0, 2 ** 31, dtype=np.int64)
+
     def service_factory(self, flat_subtask: int,
                         sidecar: "det.SidecarStore",
                         replay_feed=None, seed: int = 0, clock=None):

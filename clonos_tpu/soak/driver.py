@@ -77,6 +77,14 @@ class SoakHarness:
         self.faults_survived = 0
         self.by_kind: Dict[str, int] = {}
         self.recoveries_ms: List[float] = []
+        #: per-kill overlapped-recovery evidence: every chaos kill runs
+        #: the overlapped finalize tail, so each appends its
+        #: finalize.overlap-saved attribution and the immediate
+        #: post-recovery ledger re-diff vs the control twin (must stay
+        #: empty — a mis-speculated replay is caught HERE, before the
+        #: job resumes, not at the next fence).
+        self.kill_overlap_saved_ms: List[float] = []
+        self.kill_rediff_problems = 0
 
     # --- fault application ---------------------------------------------------
 
@@ -100,13 +108,24 @@ class SoakHarness:
         r = self.runner
         r.inject_failure(list(event.targets))
         t0 = _time.monotonic()
-        r.recover()
+        report = r.recover()
         ms = (_time.monotonic() - t0) * 1e3
         self.recoveries_ms.append(ms)
         self.faults_survived += 1
+        # Overlapped-tail acceptance, under fire: record the kill's
+        # finalize.overlap-saved attribution (present == the overlapped
+        # pipeline ran) and re-diff the ledger against the fault-free
+        # control twin IMMEDIATELY — not only at the next fence — so a
+        # mis-speculated replay is caught before the job resumes.
+        saved = report.phase_ms.get("finalize.overlap-saved", 0.0)
+        self.kill_overlap_saved_ms.append(round(saved, 1))
+        rediff = self.audit_check()
+        self.kill_rediff_problems += len(rediff)
         self.tracer.event("soak.chaos.recovered", kind="kill",
                           targets=list(event.targets),
-                          recovery_ms=round(ms, 1))
+                          recovery_ms=round(ms, 1),
+                          overlap_saved_ms=round(saved, 1),
+                          rediff_problems=len(rediff))
 
     def _apply_gray(self, event: ChaosEvent, now_s: float) -> None:
         # Degraded, not dead: the worker's heartbeats arrive late and
@@ -529,6 +548,13 @@ class SoakDriver:
                 "by_kind": dict(sorted(h.by_kind.items())),
                 "recoveries_ms": [round(m, 1)
                                   for m in h.recoveries_ms],
+                # Overlapped recovery under chaos kill: per-kill
+                # finalize.overlap-saved attribution, and the count of
+                # ledger problems from the immediate post-kill re-diff
+                # vs the control twin (0 == every overlapped recovery
+                # left bit-identical state).
+                "kill_overlap_saved_ms": list(h.kill_overlap_saved_ms),
+                "kill_rediff_problems": h.kill_rediff_problems,
             },
             "audit": {
                 "enabled": audited,

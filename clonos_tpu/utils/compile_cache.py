@@ -12,11 +12,20 @@ their executables are incompatible across partitioner versions — so
 :func:`enable_compile_cache` accepts the mesh + PartitionSpec pytree
 and keys a per-sharding subdirectory from their fingerprints. Unsharded
 and sharded runs therefore never collide in the persistent cache.
+
+The standby/bootstrap path wires through here too
+(``ClusterRunner(compile_cache_dir=...)`` /
+``ClusterRunner.bootstrap_standby(compile_cache_dir=...)``): the
+first-step executable :func:`aot_lower_first_step` produces at prewarm
+persists across a process restart, so a rebooted standby's in-bootstrap
+AOT warm is a persistent-cache HIT instead of the full
+``finalize.first-step-recompile`` XLA compile.
 """
 
 from __future__ import annotations
 
 import os
+import time
 from typing import Any, Optional
 
 
@@ -43,7 +52,12 @@ def enable_compile_cache(cache_dir: str, mesh: Optional[Any] = None,
                                  sharding_cache_key(mesh, specs))
     os.makedirs(cache_dir, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", cache_dir)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    # Only compiles past this wall are persisted (dodges churn from
+    # trivial jits). CLONOS_COMPILE_CACHE_MIN_S=0 forces everything in
+    # — small jobs whose block compiles beat 0.5 s still want their
+    # first-step executable to survive a restart.
+    min_s = float(os.environ.get("CLONOS_COMPILE_CACHE_MIN_S", "0.5"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", min_s)
     try:
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
     except Exception:                              # pragma: no cover
@@ -51,27 +65,35 @@ def enable_compile_cache(cache_dir: str, mesh: Optional[Any] = None,
     return cache_dir
 
 
-def aot_lower_first_step(executor) -> Optional[Any]:
+def aot_lower_first_step(executor, metric_group: Optional[Any] = None
+                         ) -> Optional[Any]:
     """Ahead-of-time lower + compile the standby's FIRST-STEP program —
-    the sharded block program a rehydrating standby dispatches before
-    anything else — so its executable is in the persistent cache (and
-    XLA's in-process cache) before any failure happens. BENCH_r05 puts
+    the block program a rehydrating standby dispatches before anything
+    else — so its executable is in the persistent cache (and XLA's
+    in-process cache) before any failure happens. BENCH_r05 puts
     first-step-recompile inside the dominant ~448 ms finalize tail; a
     cache hit removes it.
 
     Lowering uses the executor's live carry avals + shardings (no
     execution, no donation — ``lower`` only traces). Returns the
     compiled executable, or None when lowering is unsupported on this
-    backend/version (callers treat AOT warmup as best-effort)."""
-    import jax.numpy as jnp
-
-    from clonos_tpu.runtime.executor import BlockInputs
+    backend/version (callers treat AOT warmup as best-effort) — the
+    fallback is NOT silent: it emits a ``recovery.aot-lower-failed``
+    trace instant and, when ``metric_group`` is given, bumps the
+    counter of the same name, so a standby that will pay the cold
+    recompile at failover is visible in ``clonos_tpu top`` now."""
+    from clonos_tpu.obs.trace import get_tracer
+    t0 = time.monotonic()
     try:
-        k = executor.block_steps
-        bi = BlockInputs(times=jnp.zeros((k,), jnp.int32),
-                         rng_bits=jnp.zeros((k,), jnp.int32),
-                         epoch=jnp.zeros((), jnp.int32),
-                         step0=jnp.zeros((), jnp.int32), feeds=())
-        return executor._jit_block.lower(executor.carry, bi).compile()
-    except Exception:                              # pragma: no cover
+        carry = executor.carry      # one read: stable vs concurrent swap
+        exe = executor._jit_block.lower(
+            carry, executor.first_step_inputs()).compile()
+        get_tracer().complete("recovery.aot-lower",
+                              time.monotonic() - t0)
+        return exe
+    except Exception as err:
+        get_tracer().event("recovery.aot-lower-failed",
+                           error=repr(err)[:200])
+        if metric_group is not None:
+            metric_group.counter("recovery.aot-lower-failed").inc()
         return None

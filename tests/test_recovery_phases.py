@@ -1,0 +1,102 @@
+"""Finalize-phase accounting guard (PR12): the overlapped recovery
+pipeline must ATTRIBUTE its concurrency, never hide it. Invariant, for
+every RecoveryReport.phase_ms:
+
+    sum(finalize.* sub-spans) - finalize.overlap-saved == finalize
+
+Sub-spans keep their true wall durations (what each piece of work
+cost); ``finalize`` is the critical-path wall the job actually waited;
+``finalize.overlap-saved`` is the difference the worker-thread overlap
+bought. The sequential control path (``overlap_finalize=False``) keeps
+the strict partition and never writes the overlap key — its absence
+marks a control run. Wired next to the conftest lint/analyze gates:
+this file is tier-1, so any accounting regression fails CI fast.
+"""
+
+import numpy as np
+import pytest
+
+from clonos_tpu import obs
+
+
+def _finalize_identity(pm, rel=0.15, abs_ms=2.0):
+    subs = {k: v for k, v in pm.items()
+            if k.startswith("finalize.") and k != "finalize.overlap-saved"}
+    saved = pm.get("finalize.overlap-saved", 0.0)
+    assert saved >= 0.0
+    assert sum(subs.values()) - saved == pytest.approx(
+        pm["finalize"], rel=rel, abs=abs_ms), (
+        f"finalize attribution broke: subs={subs} saved={saved} "
+        f"finalize={pm['finalize']}")
+    return subs, saved
+
+
+def _window_job(name):
+    from clonos_tpu.api.environment import StreamEnvironment
+    env = StreamEnvironment(name=name, num_key_groups=8)
+    (env.synthetic_source(vocab=11, batch_size=4, parallelism=2)
+        .key_by()
+        .window_count(num_keys=11, window_size=1 << 30)
+        .sink())
+    return env.build()
+
+
+def test_recover_overlap_and_sequential_keep_the_identity(tmp_path):
+    from clonos_tpu.runtime.cluster import ClusterRunner
+
+    obs.configure("phases")
+    r = ClusterRunner(_window_job("ph"), steps_per_epoch=8,
+                      log_capacity=512, max_epochs=8,
+                      inflight_ring_steps=32, seed=3,
+                      checkpoint_dir=str(tmp_path / "ck"))
+    r.run_epoch(complete_checkpoint=True)
+    r.run_epoch(complete_checkpoint=False)
+
+    r.inject_failure([2 + 1])
+    pm = r.recover().phase_ms                  # overlapped (the default)
+    assert "finalize.overlap-saved" in pm
+    subs, _saved = _finalize_identity(pm)
+    assert {"finalize.barrier-read", "finalize.state-verify"} <= set(subs)
+
+    r.inject_failure([2 + 1])
+    cm = r.recover(overlap_finalize=False).phase_ms   # sequential control
+    assert "finalize.overlap-saved" not in cm
+    _finalize_identity(cm)
+
+
+def test_bootstrap_standby_folds_overlap_into_the_identity(tmp_path):
+    """The standby-host rebuild runs ledger derivation + RNG
+    fast-forward + AOT warm on a worker thread; its report must still
+    satisfy the identity, with the bootstrap sub-spans (rehydrate /
+    listener-reattach / first-step-recompile) folded in and the thread's
+    off-critical-path time credited to finalize.overlap-saved."""
+    from clonos_tpu.api.environment import StreamEnvironment
+    from clonos_tpu.runtime.cluster import ClusterRunner
+
+    env = StreamEnvironment(name="phboot", num_key_groups=8)
+    env.synthetic_source(vocab=7, batch_size=4, parallelism=1)
+    job = env.build()
+    ck = str(tmp_path / "ck")
+    r = ClusterRunner(job, steps_per_epoch=4, checkpoint_dir=ck,
+                      log_capacity=256, max_epochs=8, seed=2)
+    for _ in range(3):
+        r.run_epoch(complete_checkpoint=True)
+    logs = r.executor.carry.logs
+    head = int(np.asarray(logs.head)[0])
+    tail = int(np.asarray(logs.tail)[0])
+    cap = np.asarray(logs.rows).shape[1]
+    pos = np.arange(tail, head) & (cap - 1)
+    mirror_rows = {0: (np.asarray(logs.rows)[0][pos], tail)}
+
+    rebuilt, report = ClusterRunner.bootstrap_standby(
+        job, ck, mirror_rows, steps_per_epoch=4, log_capacity=256,
+        max_epochs=8, seed=2)
+    pm = report.phase_ms
+    subs, saved = _finalize_identity(pm)
+    assert {"finalize.state-rehydrate", "finalize.listener-reattach",
+            "finalize.first-step-recompile", "finalize.barrier-read",
+            "finalize.state-verify"} <= set(subs)
+    # the worker thread existed: derive+warm walls were recorded
+    assert pm["finalize.first-step-recompile"] >= 0.0
+    # the rebuilt runner is live (the join points held)
+    assert rebuilt.global_step == 12 + report.steps_replayed

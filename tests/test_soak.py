@@ -431,7 +431,13 @@ def test_soak_smoke_kill_and_gray_hold_slo_and_audit(tmp_path):
     """~20s smoke: a paced run takes one kill + one gray failure and
     must come out with every SLO window evaluated on corrected latency,
     both faults survived, and the audit ledger byte-identical to the
-    fault-free control chain (exactly_once: true)."""
+    fault-free control chain (exactly_once: true). The kill exercises
+    the OVERLAPPED recovery tail end-to-end: its window is held to a
+    per-window max_recovery_ms budget, the finalize.overlap-saved
+    attribution is recorded per kill, and the immediate post-kill
+    ledger re-diff vs the control twin stays empty. (The 150 ms device
+    budget is asserted by bench.py at bench shapes; the CPU-CI bound
+    here guards the SLO plumbing, not device latency.)"""
     from clonos_tpu.soak import SLOSpec, SoakConfig, SoakDriver
 
     runner, control, election = _fixture(tmp_path, duration_s=5.0)
@@ -440,7 +446,8 @@ def test_soak_smoke_kill_and_gray_hold_slo_and_audit(tmp_path):
     driver = SoakDriver(
         runner, SoakConfig(rate=1200.0, duration_s=5.0, window_s=2.0,
                            chunk_steps=8),
-        schedule=schedule, spec=SLOSpec(exactly_once=True),
+        schedule=schedule,
+        spec=SLOSpec(exactly_once=True, max_recovery_ms=30000.0),
         control=control, election=election, records_per_step=16)
     v = driver.run()
 
@@ -452,6 +459,11 @@ def test_soak_smoke_kill_and_gray_hold_slo_and_audit(tmp_path):
     assert v["faults"]["survived"] == 2
     assert v["faults"]["by_kind"] == {"gray": 1, "kill": 1}
     assert v["faults"]["recoveries_ms"]          # the kill's recovery
+    assert v["slo"]["max_recovery_ms"] == 30000.0
+    # overlapped-recovery acceptance under chaos kill
+    assert len(v["faults"]["kill_overlap_saved_ms"]) == 1
+    assert v["faults"]["kill_overlap_saved_ms"][0] >= 0.0
+    assert v["faults"]["kill_rediff_problems"] == 0
     assert v["windows"] and all(
         "p99_ms" in w and "p50_ms" in w for w in v["windows"])
     assert "corrected" in v["latency"]["basis"]
