@@ -1080,6 +1080,10 @@ def main(jobs=None, multichip=None, soak=None, ablate=False,
     # chip (jax frees buffers on GC).
     import gc
     del runner, report, mgr, replayer, result, warm_runs, warm_report
+    # _val retains the executor (and its carry) — dropping `runner`
+    # alone would keep the device state alive through the secondary
+    # configs below.
+    del _val, entries_overlap, entries_seq
     gc.collect()
     # Secondary BASELINE configs (#4 cascading, #5 join + external-service
     # calls) and the determinant-sharing-depth trade-off sweep. Guarded by

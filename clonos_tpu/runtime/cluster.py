@@ -1601,11 +1601,14 @@ class ClusterRunner:
         ``overlap_finalize`` selects the finalize pipeline: overlapped
         (the default, via ``self.overlap_recovery``) drains the final
         packed barrier-read on a worker thread while the main thread
-        runs revive bookkeeping and the audit validator, with an
-        explicit join + deferred-assert check before returning;
-        ``False`` is the strictly-sequential control (barrier-read →
-        state-verify → revive → audit) that bench/soak diff the
-        overlapped path's ledger against.
+        runs the audit validator, with an explicit join +
+        deferred-assert check before returning; revive bookkeeping
+        runs only after the join and state-verify pass (the same
+        safety order as the control — a failed verify leaves the
+        subtasks marked dead, and an audit divergence is re-raised
+        after verify). ``False`` is the strictly-sequential control
+        (barrier-read → state-verify → revive → audit) that bench/soak
+        diff the overlapped path's ledger against.
 
         ``pre_patch_join`` is the bootstrap-overlap hook: a callable
         joined (once) immediately before the FIRST ``_patch`` call —
@@ -2020,14 +2023,17 @@ class ClusterRunner:
         # transfer (dispatch-order barrier: it pays for every program
         # still in flight), ``finalize.state-verify`` = the host-side
         # deferred asserts. Overlapped mode drains the transfer on a
-        # worker thread while the main thread runs revive bookkeeping
-        # and the audit validator inside the same window; the sub-spans
-        # keep their true walls and ``finalize.overlap-saved`` carries
-        # the credit, so sum(finalize.*) - overlap-saved == finalize
-        # (overlap attributed, never hidden). The join + deferred
-        # asserts run before recover() returns — a mis-speculated
-        # fast-path replay raises here, before any live step, with the
-        # audit validator as an independent gate on the replayed state.
+        # worker thread while the main thread runs the audit validator
+        # inside the same window; the sub-spans keep their true walls
+        # and ``finalize.overlap-saved`` carries the credit, so
+        # sum(finalize.*) - overlap-saved == finalize (overlap
+        # attributed, never hidden). The join + deferred asserts run
+        # before recover() returns — a mis-speculated fast-path replay
+        # raises here, before any live step, with the audit validator
+        # as an independent gate on the replayed state. Revive
+        # bookkeeping runs after verify in BOTH modes: a failed
+        # barrier/verify/audit leaves the subtasks marked dead so the
+        # failure is retryable, never silently "healthy".
         overlap = (self.overlap_recovery if overlap_finalize is None
                    else bool(overlap_finalize))
         t_fin0 = tp
@@ -2164,6 +2170,7 @@ class ClusterRunner:
             return a_ms
 
         audit_ms = 0.0
+        audit_err: Optional[Exception] = None
         if overlap:
             th = threading.Thread(target=_drain_barrier,
                                   name="recovery-finalize-barrier")
@@ -2172,10 +2179,25 @@ class ClusterRunner:
             # the audit validator's digest recompute reads the same
             # patched carry the packed read waits on (its transfers
             # interleave with the barrier d2h instead of queuing after
-            # it), and revive bookkeeping is host-only.
-            _revive()
-            audit_ms = _audit()
-            th.join()
+            # it). Revive bookkeeping does NOT fold in: it must stay
+            # after the join + state-verify below, exactly as in the
+            # sequential control — if the packed read or a deferred
+            # assert raises, self.failed and the heartbeat table must
+            # still mark the subtasks dead so a retry of recover()
+            # sees them. An audit divergence is held and re-raised
+            # after verify (the control's diagnostic order: a verify
+            # failure wins), and the join runs unconditionally so the
+            # barrier thread never outlives this call.
+            t_a0 = _time.monotonic()
+            try:
+                audit_ms = _audit()
+            except Exception as err:
+                audit_err = err
+                audit_ms = (_time.monotonic() - t_a0) * 1e3
+            finally:
+                # KeyboardInterrupt/SystemExit skip the deferral but
+                # still land here: the thread never leaks.
+                th.join()
         else:
             _drain_barrier()
         if barrier["err"] is not None:
@@ -2198,6 +2220,18 @@ class ClusterRunner:
                               drill=drill)
         tp = now_v
         if overlap:
+            # Same safety order as the control: verify passed, NOW the
+            # subtasks may be marked healthy; a deferred audit
+            # divergence propagates after revive, exactly where the
+            # sequential path would raise it.
+            _revive()
+            if audit_err is not None:
+                raise audit_err
+            # Unclamped, this is min(audit wall, barrier wall) — both
+            # sub-spans keep their true walls while the window paid
+            # only the longer of the two; revive runs outside the
+            # window in both modes so no wall hides in the clamp
+            # (which only absorbs sub-ms thread-start jitter).
             phases["finalize.overlap-saved"] = (
                 phases.get("finalize.overlap-saved", 0.0)
                 + max(0.0, barrier["ms"] + verify_ms - fin_ms))

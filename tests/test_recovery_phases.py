@@ -100,3 +100,93 @@ def test_bootstrap_standby_folds_overlap_into_the_identity(tmp_path):
     assert pm["finalize.first-step-recompile"] >= 0.0
     # the rebuilt runner is live (the join points held)
     assert rebuilt.global_step == 12 + report.steps_replayed
+
+
+def test_overlap_verify_failure_keeps_subtasks_dead_and_retryable(tmp_path):
+    """Safety-order guard: in overlapped mode, revive bookkeeping must
+    run AFTER the barrier join + state-verify (the sequential order). A
+    packed-read deferred assert that raises must leave ``self.failed``
+    and the heartbeat dead-set intact, so the failure is visible and
+    ``recover()`` can simply be retried; the barrier thread must not
+    outlive the call."""
+    import threading
+
+    from clonos_tpu.causal import recovery as rec
+    from clonos_tpu.runtime.cluster import ClusterRunner
+
+    r = ClusterRunner(_window_job("phdead"), steps_per_epoch=8,
+                      log_capacity=512, max_epochs=8,
+                      inflight_ring_steps=32, seed=3,
+                      checkpoint_dir=str(tmp_path / "ck"))
+    r.run_epoch(complete_checkpoint=True)
+    r.run_epoch(complete_checkpoint=False)
+
+    flat = 2 + 1
+    orig_bounds = r._ring_bounds_dev
+    assert orig_bounds() is not None        # the job has in-flight rings
+    r.inject_failure([flat])
+    # Deterministic verify trip: skew the ring-bounds lanes of the
+    # packed read so the deferred assert sees device bounds that
+    # contradict the host mirror. Routing coverage decisions read the
+    # (valid, untampered) host mirror, so the replay itself is sound —
+    # only the final state-verify fires.
+    r._ring_bounds_dev = lambda: orig_bounds() + 1
+    with pytest.raises(rec.RecoveryError, match="state suspect"):
+        r.recover()
+    assert flat in r.failed                    # NOT marked healthy
+    assert flat in r.heartbeats._dead
+    assert not any(t.name == "recovery-finalize-barrier"
+                   for t in threading.enumerate())
+    # Un-tamper and retry: the protocol reruns end-to-end, and only a
+    # recover() that passed verify revives the subtask.
+    r._ring_bounds_dev = orig_bounds
+    report = r.recover()
+    assert not r.failed
+    assert flat not in r.heartbeats._dead
+    assert "finalize.state-verify" in report.phase_ms
+
+
+def test_overlap_audit_divergence_defers_past_verify_and_joins(tmp_path):
+    """An audit divergence under the abort policy in overlapped mode
+    must not short-circuit the window: the barrier thread is joined,
+    state-verify's deferred asserts still run, revive keeps its
+    sequential place, and only then does AuditDivergenceError
+    propagate — the same observable order as the sequential control."""
+    import json
+    import threading
+
+    from clonos_tpu.causal.recovery import AuditDivergenceError
+    from clonos_tpu.runtime.cluster import ClusterRunner
+
+    tr = obs.configure("phaud")
+    r = ClusterRunner(_window_job("phaud"), steps_per_epoch=8,
+                      log_capacity=512, max_epochs=8,
+                      inflight_ring_steps=32, seed=3,
+                      checkpoint_dir=str(tmp_path / "ck"),
+                      audit=True, audit_on_divergence="abort")
+    r.run_epoch(complete_checkpoint=True)
+    r.run_epoch(complete_checkpoint=False)
+
+    # Tamper every sealed fingerprint on disk: whatever epoch window the
+    # recovery validates, its recompute diverges from the ledger.
+    ledger = tmp_path / "ck" / "ledger.jsonl"
+    entries = [json.loads(ln) for ln in
+               ledger.read_text().splitlines() if ln]
+    for e in entries:
+        for ch in e["channels"].values():
+            ch["fp"] = "00" * 8
+    ledger.write_text("".join(json.dumps(e) + "\n" for e in entries))
+
+    flat = 2 + 1
+    r.inject_failure([flat])
+    with pytest.raises(AuditDivergenceError):
+        r.recover()
+    # state-verify ran before the deferred divergence propagated
+    assert any(x["name"] == "recovery.finalize.state-verify"
+               for x in tr.records())
+    # ... and so did revive (verify passed), matching the sequential
+    # control where the abort fires after barrier→verify→revive.
+    assert not r.failed
+    assert flat not in r.heartbeats._dead
+    assert not any(t.name == "recovery-finalize-barrier"
+                   for t in threading.enumerate())
