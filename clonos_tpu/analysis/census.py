@@ -243,6 +243,19 @@ def static_cost_model(census: Dict, *, steps_per_epoch: int,
     spill_payload = (ring_bytes + det_bytes) if spill else 0
     spill_d2h = spill_payload
     spill_disk = spill_payload
+    # Fence-tail lanes — the per-epoch bytes the pipelined fence
+    # (runtime/cluster.py run_epoch overlap mode) moves off the
+    # critical path, itemized so the predicted hidden tail is
+    # attributable. Seal: the audit digest d2h's the epoch's causal
+    # surface (owner determinant windows + ring slices). Ledger: one
+    # JSON line with a fixed header plus one fingerprint per channel
+    # (owner logs + rings), ~64 bytes each as serialized. Snapshot: the
+    # lean fence offsets (per-log heads + per-ring heads + record
+    # counts, int64-scale per lane) — operator state is job-dependent
+    # and priced by the data lane, not here.
+    fence_seal = det_bytes + ring_bytes
+    fence_ledger = 64 * (1 + subtasks + ring_vertices)
+    fence_snapshot = 8 * (2 * subtasks + ring_vertices)
     ft_bytes = (det_bytes + replica_bytes + ring_bytes
                 + spill_d2h + spill_disk)
     total = ft_bytes + data_bytes
@@ -256,6 +269,9 @@ def static_cost_model(census: Dict, *, steps_per_epoch: int,
         "data_bytes_per_epoch": data_bytes,
         "spill_d2h_bytes_per_epoch": spill_d2h,
         "spill_disk_bytes_per_epoch": spill_disk,
+        "fence_seal_bytes_per_epoch": fence_seal,
+        "fence_ledger_bytes_per_epoch": fence_ledger,
+        "fence_snapshot_bytes_per_epoch": fence_snapshot,
         "ft_fraction_static": (round(ft_bytes / total, 6)
                                if total else 0.0),
     }

@@ -165,7 +165,11 @@ def merge_determinant_responses(
     merged view is the one reaching furthest, extended left to the earliest
     start. Verifies overlap consistency (bit-equality on shared offsets)."""
     if not responses:
-        return np.zeros((0, 0), np.int32), 0
+        # Lane-shaped empty, not (0, 0): a zero-step replay (kill right
+        # after a completed fence — the pipelined fence's joined tail
+        # lands exactly there) still column-indexes the merged rows.
+        from clonos_tpu.causal import determinant as det
+        return np.zeros((0, det.NUM_LANES), np.int32), 0
     best_rows, best_start = None, 0
     for rows, start in responses:
         if best_rows is None:

@@ -1,8 +1,9 @@
 """Static determinism lint for the Clonos causal-services contract.
 
 ``clonos_tpu lint [paths...]`` — see ``core`` for the rule registry,
-``nondet``/``tracesafe``/``concurrency``/``markers`` for the rule
-families, ``waivers`` for exemption syntax, ``runner`` for the driver.
+``nondet``/``tracesafe``/``concurrency``/``markers``/``overlapwindow``
+for the rule families, ``waivers`` for exemption syntax, ``runner`` for
+the driver.
 
 Importing this package registers every built-in rule; external rules
 register the same way (subclass ``Rule``, decorate with
@@ -17,6 +18,7 @@ from clonos_tpu.lint.core import (ERROR, WARNING, RULES, FileContext,
 from clonos_tpu.lint import concurrency  # noqa: F401
 from clonos_tpu.lint import markers      # noqa: F401
 from clonos_tpu.lint import nondet       # noqa: F401
+from clonos_tpu.lint import overlapwindow  # noqa: F401
 from clonos_tpu.lint import tracesafe    # noqa: F401
 from clonos_tpu.lint.runner import (DEFAULT_WAIVER_FILE, LintResult,
                                     format_json, format_text, run_lint)

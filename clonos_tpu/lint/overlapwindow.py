@@ -1,0 +1,92 @@
+"""Rule family 5: host synchronization inside the pipelined-fence
+overlap window.
+
+The pipelined fence (runtime/cluster.py ``_begin_fence_tail``) promises
+that everything between its ``# clonos: overlap-window-begin`` /
+``# clonos: overlap-window-end`` markers is DISPATCH-ONLY: device
+programs and async d2h starts, never a host block. One stray
+``np.asarray`` / ``jax.block_until_ready`` there silently re-serializes
+the exact tail the pipeline exists to hide — the steady-state headline
+regresses with no functional symptom, which is why this is a lint rule
+and not a test. The async-safe primitive ``copy_to_host_async`` is
+explicitly allowed; its blocking cousins are not.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from clonos_tpu.lint.core import (FileContext, Finding, Rule,
+                                  register_rule)
+
+BEGIN = "clonos: overlap-window-begin"
+END = "clonos: overlap-window-end"
+
+#: canonical dotted names that force a host synchronization.
+SYNC_CALLS = {
+    "numpy.asarray", "numpy.array", "numpy.copy",
+    "jax.block_until_ready", "jax.device_get",
+}
+
+#: method names that block regardless of receiver resolution
+#: (``arr.block_until_ready()``); ``copy_to_host_async`` is the allowed
+#: non-blocking start and deliberately absent.
+SYNC_ATTRS = {"block_until_ready", "copy_to_host", "item", "tolist"}
+
+
+def _windows(ctx: FileContext) -> List[tuple]:
+    """(begin_line, end_line) pairs of every marked overlap window."""
+    out, start = [], None
+    for i, ln in enumerate(ctx.lines, start=1):
+        if BEGIN in ln:
+            start = i
+        elif END in ln and start is not None:
+            out.append((start, i))
+            start = None
+    return out
+
+
+@register_rule
+class OverlapWindowSyncRule(Rule):
+    name = "overlap-window"
+    description = ("host synchronization (np.asarray / "
+                   "block_until_ready / device_get) inside a pipelined-"
+                   "fence overlap window — re-serializes the hidden tail")
+
+    def check(self, ctx: FileContext) -> List[Finding]:
+        wins = _windows(ctx)
+        out: List[Finding] = []
+        # an unclosed begin marker is itself a finding: the window it
+        # was supposed to bound is silently unchecked.
+        opens = sum(BEGIN in ln for ln in ctx.lines)
+        if opens != len(wins):
+            out.append(self.finding(
+                ctx, 1, "unbalanced overlap-window markers "
+                        f"({opens} begin / {len(wins)} closed)"))
+        if not wins:
+            return out
+        seen = set()
+        for node in ast.walk(ctx.tree):
+            line = getattr(node, "lineno", None)
+            if line is None or not any(b < line < e for b, e in wins):
+                continue
+            dotted = None
+            if isinstance(node, (ast.Attribute, ast.Name)):
+                dotted = ctx.resolve(node)
+            hit = None
+            if dotted in SYNC_CALLS:
+                hit = dotted
+            elif (isinstance(node, ast.Attribute)
+                  and node.attr in SYNC_ATTRS):
+                hit = f"<expr>.{node.attr}"
+            if hit is None or (line, hit) in seen:
+                continue
+            seen.add((line, hit))
+            out.append(self.finding(
+                ctx, line,
+                f"`{hit}` blocks on device results inside the "
+                f"pipelined-fence overlap window — keep the window "
+                f"dispatch-only (copy_to_host_async is the async "
+                f"primitive), or move the read to the fence worker"))
+        return out

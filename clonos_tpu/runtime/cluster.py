@@ -250,6 +250,7 @@ class ClusterRunner:
                  audit_on_divergence: Optional[str] = None,
                  compile_cache_dir: Optional[str] = None,
                  overlap_recovery: bool = True,
+                 overlap_epoch: bool = False,
                  **executor_kw):
         self.job = job
         #: persistent XLA compile cache, namespaced by mesh+spec
@@ -275,6 +276,30 @@ class ClusterRunner:
         #: sequential escape hatch (False) is the bit-identity control
         #: bench/soak diff the overlapped path against.
         self.overlap_recovery = overlap_recovery
+        #: pipelined fence default for run_epoch(): True hands the
+        #: fence tail (health drain, audit seal, ledger append,
+        #: checkpoint write) to a worker thread that overlaps the next
+        #: epoch's compute, joining before the next fence — at most one
+        #: tail in flight. Defaults to False (today's strict order):
+        #: overlap defers checkpoint completion/truncation and ledger
+        #: visibility by one fence, which callers must opt into. The
+        #: sequential control run never writes the fence.overlap-saved
+        #: key — its absence marks the control.
+        self.overlap_epoch = overlap_epoch
+        #: in-flight fence tail (pipelined fence): None, or a dict with
+        #: the worker thread + its captured handles/results. Joined at
+        #: the next fence, before any failure injection, and before
+        #: recover() — never survives past one epoch.
+        self._fence_tail: Optional[dict] = None
+        #: fence attribution of the last joined/sequential fence:
+        #: fence.* sub-spans (true walls), "fence-tail" (critical-path
+        #: wall the epoch actually waited), and — overlapped only —
+        #: "fence.overlap-saved", preserving
+        #: sum(fence.*) - overlap-saved == fence-tail.
+        self.last_fence_phases: Dict[str, float] = {}
+        #: cumulative fence.overlap-saved milliseconds (bench reads it)
+        self.fence_overlap_saved_total_ms = 0.0
+        self._fence_headroom_checked = False
         if compile_cache_dir:
             mesh0 = self.executor.compiled.mesh
             if mesh0 is not None:
@@ -1355,17 +1380,45 @@ class ClusterRunner:
 
     # --- steady state --------------------------------------------------------
 
-    def run_epoch(self, complete_checkpoint: bool = True) -> None:
+    def run_epoch(self, complete_checkpoint: bool = True,
+                  overlap_fence: Optional[bool] = None) -> None:
         """Run to the next epoch fence and trigger its checkpoint.
 
         ``complete_checkpoint=False`` leaves the checkpoint pending (no
         acks): logs keep accumulating across epochs — the large-checkpoint-
         interval regime the spillable in-flight log exists for, and the
-        setup for multi-epoch recovery gaps."""
+        setup for multi-epoch recovery gaps.
+
+        ``overlap_fence`` (default: the runner's ``overlap_epoch``)
+        selects the pipelined fence: the closed epoch's fence state is
+        captured as device-side handles (async health d2h, epoch-window
+        copies, lean snapshot) and the tail — health drain, audit seal,
+        group-committed ledger append, async checkpoint write, spill
+        digests — drains on a single fence-worker thread while the NEXT
+        epoch's compute runs; the worker joins at the next fence, so at
+        most one tail is ever in flight. Deferred with it, by at most
+        one epoch, are the overflow check (re-run from the async health
+        read before the ring can wrap twice; one epoch of ring headroom
+        is asserted once), checkpoint completion/truncation, and ledger
+        visibility — ``drain_fence()`` settles all of it on demand.
+        ``overlap_fence=False`` keeps today's strict order and never
+        writes the ``fence.overlap-saved`` attribution key — its
+        absence marks a sequential control run."""
         if self.failed:
             raise rec.RecoveryError(
                 f"cannot run with failed subtasks {sorted(self.failed)}; "
                 f"call recover() first")
+        overlap = (self.overlap_epoch if overlap_fence is None
+                   else overlap_fence)
+        if overlap and not self._fence_headroom_checked:
+            self._check_fence_headroom()
+        # A mode switch settles strictly — and so does spill, whose
+        # host store the in-flight worker (attach_spill_digests) and
+        # this epoch's spill hook would otherwise race: join BEFORE
+        # dispatching this epoch's compute.
+        if self._fence_tail is not None and (
+                not overlap or self.executor.spill_logs is not None):
+            self._join_fence_tail()
         closed = self.executor.epoch_id
         n = self.executor.steps_per_epoch - self.executor.step_in_epoch
         tr = get_tracer()
@@ -1375,113 +1428,37 @@ class ClusterRunner:
         try:
             t0 = _time.monotonic()
             self.executor.run_epoch()
-            # Enabled profiler: fence the carry so "compute" measures
-            # execution, not dispatch (the fused block program = user
-            # compute + in-program causal/ring appends).
-            prof.fence(self.executor.carry)
+            if not overlap:
+                # Enabled profiler: fence the carry so "compute"
+                # measures execution, not dispatch (the fused block
+                # program = user compute + in-program causal/ring
+                # appends). Never on the overlapped path — this block
+                # would serialize exactly the window the pipeline
+                # hides, so overlapped "compute" is dispatch wall only.
+                prof.fence(self.executor.carry)
             steps_s = _time.monotonic() - t0
             self._m_epoch_steps_ms.update(steps_s * 1e3)
             tr.complete("epoch.steps", steps_s, epoch=closed, steps=n)
             prof.observe("compute", steps_s, kind="compute")
+            # The PREVIOUS epoch's tail joins here: after this epoch's
+            # compute is dispatched (the tail overlapped it), before any
+            # of this fence's state is touched. The join re-raises
+            # worker errors, runs the deferred overflow check, and
+            # acks/truncates its checkpoint on this (the main) thread.
+            self._join_fence_tail()
             t_fence = _time.monotonic()
             self.global_step += n
             self._fence_step[self.executor.epoch_id] = self.global_step
             self.heartbeats.beat_all_except(self.failed)
             self._m_steps.inc(n)
             self._m_epochs.inc()
-            # One fused device read per epoch: overflow flags + record
-            # total + fence log heads (the tunnel round-trip is the cost
-            # unit here, not device work).
-            with prof.section("health-read"):
-                vec = self.executor.health_vector()
-            nf = 4 + len(self.executor.carry.out_rings)
-            total_records = int(vec[nf])
-            # The heads at this fence ARE checkpoint ``closed``'s log
-            # heads (the SOURCE_CHECKPOINT appends below come after and
-            # belong to the new epoch) — recovery's patch phase reads
-            # them from here instead of paying a device round-trip on
-            # the failure path.
-            self._ck_log_heads[closed] = vec[nf + 1:].astype(np.int64)
-            # Bounded even when checkpoints never complete (the
-            # completion hook prunes harder): a pruned-but-needed entry
-            # only costs the patch fallback's one device read.
-            if len(self._ck_log_heads) > 128:
-                for k in sorted(self._ck_log_heads)[:-128]:
-                    del self._ck_log_heads[k]
-            delta_records = total_records - self._last_records_total
-            self._m_records.mark(delta_records)
-            self._last_records_total = total_records
-            # Overflow guards at every roll: an un-truncated ring that
-            # wrapped has silently clobbered recovery state — fail
-            # loudly, never limp.
-            violations = self.executor.overflow_messages(vec)
-            if violations:
-                raise OverflowError_("; ".join(violations))
-            # Host epoch control plane mirrors the fence.
-            self.epoch_tracker.inc_record_count(delta_records)
-            self.epoch_tracker.start_new_epoch(self.executor.epoch_id)
             if self.latency is not None:
                 self.latency.observe()
-            # Audit seal at the fence (obs/audit.py): digest the closed
-            # epoch's causal surface while its log/ring windows are
-            # still resident (completion below truncates them), persist
-            # the ledger entry next to the checkpoint, and fan out on
-            # the epoch tracker's seal bus. The SOURCE_CHECKPOINT
-            # appends after the snapshot land past this epoch's window
-            # end, so the seal is fence-exact.
-            if self.auditor.enabled:
-                from clonos_tpu.obs import audit as _audit_mod
-                with prof.section("digest-seal"):
-                    dg = _audit_mod.digest_epoch_window(
-                        closed, self.executor.epoch_window(closed))
-                    self.auditor.seal(dg)
-                with prof.section("ledger-write"):
-                    self.coordinator.record_ledger(dg.to_entry())
-                if self.executor.spill_logs is not None:
-                    # Segment index entries inherit the ledger's channel
-                    # fingerprints — spill/refill round-trips become
-                    # audit-verifiable (storage/tiered.py docstring).
-                    self.executor.attach_spill_digests(closed, dg)
-                self.epoch_tracker.notify_epoch_sealed(closed, dg)
-                self._m_audit_sealed.inc()
-            # Checkpoint at the fence: the lean fence snapshot (op state
-            # + offsets; logs/rings are truncated on completion, not
-            # persisted).
-            with prof.section("snapshot"):
-                self.coordinator.trigger(
-                    closed, self.executor.lean_snapshot(),
-                    async_write=False, owned=True)
-            # The checkpoint-trigger RPC arrival is nondeterministic in
-            # the reference and logged by every source
-            # (StreamTask.performCheckpoint:833-840); fence-aligned here,
-            # but the determinant is still recorded for replay/wire
-            # parity — one fused device append for all sources, AFTER
-            # the lean snapshot so the checkpointed log heads stay
-            # aligned with the fence offsets (the rows belong to the new
-            # epoch).
-            if self._source_flats:
-                t_ms = (self.executor.step_input_history[-1][0]
-                        if self.executor.step_input_history else 0)
-                with prof.section("source-append"):
-                    self.executor.append_async_many(
-                        self._source_flats,
-                        det.SourceCheckpointDeterminant(
-                            record_count=(
-                                self.executor.global_record_stamp()),
-                            checkpoint_id=closed, timestamp=t_ms))
-                    prof.fence(self.executor.carry.logs)
-            for tl in self.txn_logs.values():
-                tl.seal(closed)
-            # Before completion: ack_all truncates rings up to this
-            # fence, so anything reading their fresh steps (edge
-            # exports) goes now.
-            for hook in self.fence_hooks:
-                hook(closed)
-            if complete_checkpoint:
-                self.coordinator.ack_all(closed)
-            fence_s = _time.monotonic() - t_fence
-            self._m_epoch_fence_ms.update(fence_s * 1e3)
-            tr.complete("epoch.fence", fence_s, epoch=closed)
+            if overlap:
+                self._begin_fence_tail(closed, complete_checkpoint, prof)
+            else:
+                self._run_fence_tail_inline(
+                    closed, complete_checkpoint, t_fence, tr, prof)
             # Close the attribution window: FT seconds / (FT + compute)
             # since the previous fence -> the overhead.ft-fraction
             # gauge (a no-op returning 0.0 on the NullProfiler).
@@ -1490,6 +1467,284 @@ class ClusterRunner:
             epoch_span.__exit__(type(e), e, e.__traceback__)
             raise
         epoch_span.__exit__(None, None, None)
+
+    def _absorb_fence_health(self, closed: int, vec: np.ndarray) -> int:
+        """Fold one fence's drained health vector into the host mirrors
+        (runs inline on the sequential path, on the fence worker when
+        pipelined). Returns the epoch's record delta."""
+        nf = 4 + len(self.executor.carry.out_rings)
+        total_records = int(vec[nf])
+        # The heads at this fence ARE checkpoint ``closed``'s log
+        # heads (the SOURCE_CHECKPOINT appends come after and belong
+        # to the new epoch) — recovery's patch phase reads them from
+        # here instead of paying a device round-trip on the failure
+        # path.
+        self._ck_log_heads[closed] = vec[nf + 1:].astype(np.int64)
+        # Bounded even when checkpoints never complete (the completion
+        # hook prunes harder). Epochs arrive in monotonic order, so
+        # evicting in insertion order is oldest-first and O(1) — a
+        # pruned-but-needed entry only costs the patch fallback's one
+        # device read.
+        while len(self._ck_log_heads) > 128:
+            self._ck_log_heads.pop(next(iter(self._ck_log_heads)))
+        delta_records = total_records - self._last_records_total
+        self._m_records.mark(delta_records)
+        self._last_records_total = total_records
+        return delta_records
+
+    def _seal_and_trigger(self, closed: int, window_fn, snap_fn,
+                          phases: Dict[str, float], prof,
+                          async_write: bool) -> None:
+        """The fence tail's persistence half, shared verbatim by both
+        modes: audit seal over the closed epoch's causal surface,
+        ledger append, spill digests, seal fan-out, checkpoint trigger.
+        ``window_fn``/``snap_fn`` abstract WHERE the state comes from —
+        the live carry (sequential) or captured device handles
+        (pipelined) — so the digests are byte-identical either way."""
+        if self.auditor.enabled:
+            from clonos_tpu.obs import audit as _audit_mod
+            t = _time.monotonic()
+            with prof.section("digest-seal"):
+                dg = _audit_mod.digest_epoch_window(closed, window_fn())
+                self.auditor.seal(dg)
+            phases["fence.digest-seal"] = (_time.monotonic() - t) * 1e3
+            t = _time.monotonic()
+            with prof.section("ledger-write"):
+                self.coordinator.record_ledger(dg.to_entry())
+            phases["fence.ledger-write"] = (_time.monotonic() - t) * 1e3
+            if self.executor.spill_logs is not None:
+                # Segment index entries inherit the ledger's channel
+                # fingerprints — spill/refill round-trips become
+                # audit-verifiable (storage/tiered.py docstring).
+                self.executor.attach_spill_digests(closed, dg)
+            self.epoch_tracker.notify_epoch_sealed(closed, dg)
+            self._m_audit_sealed.inc()
+        # Checkpoint at the fence: the lean fence snapshot (op state
+        # + offsets; logs/rings are truncated on completion, not
+        # persisted).
+        t = _time.monotonic()
+        with prof.section("snapshot"):
+            self.coordinator.trigger(closed, snap_fn(),
+                                     async_write=async_write, owned=True)
+            if async_write:
+                self.coordinator.drain()
+        phases["fence.snapshot"] = (_time.monotonic() - t) * 1e3
+
+    def _append_source_fence_determinant(self, closed: int,
+                                         phases: Dict[str, float],
+                                         prof) -> None:
+        """The checkpoint-trigger RPC arrival is nondeterministic in
+        the reference and logged by every source
+        (StreamTask.performCheckpoint:833-840); fence-aligned here, but
+        the determinant is still recorded for replay/wire parity — one
+        fused device append for all sources, AFTER the fence capture /
+        lean snapshot so the checkpointed log heads stay aligned with
+        the fence offsets (the rows belong to the new epoch)."""
+        if not self._source_flats:
+            return
+        t_ms = (self.executor.step_input_history[-1][0]
+                if self.executor.step_input_history else 0)
+        t = _time.monotonic()
+        with prof.section("source-append"):
+            self.executor.append_async_many(
+                self._source_flats,
+                det.SourceCheckpointDeterminant(
+                    record_count=self.executor.global_record_stamp(),
+                    checkpoint_id=closed, timestamp=t_ms))
+            prof.fence(self.executor.carry.logs)
+        phases["fence.source-append"] = (_time.monotonic() - t) * 1e3
+
+    def _run_fence_tail_inline(self, closed: int,
+                               complete_checkpoint: bool,
+                               t_fence: float, tr, prof) -> None:
+        """Today's strict fence order, inline on the calling thread —
+        the sequential control. Phases land in ``last_fence_phases``
+        under the same ``fence.*`` keys as the pipelined path, minus
+        the overlap key (its absence marks the control run)."""
+        phases: Dict[str, float] = {}
+        # One fused device read per epoch: overflow flags + record
+        # total + fence log heads (the tunnel round-trip is the cost
+        # unit here, not device work).
+        t = _time.monotonic()
+        with prof.section("health-read"):
+            vec = self.executor.health_vector()
+        phases["fence.health-read"] = (_time.monotonic() - t) * 1e3
+        delta_records = self._absorb_fence_health(closed, vec)
+        # Overflow guards at every roll: an un-truncated ring that
+        # wrapped has silently clobbered recovery state — fail
+        # loudly, never limp.
+        violations = self.executor.overflow_messages(vec)
+        if violations:
+            raise OverflowError_("; ".join(violations))
+        # Host epoch control plane mirrors the fence.
+        self.epoch_tracker.inc_record_count(delta_records)
+        self.epoch_tracker.start_new_epoch(self.executor.epoch_id)
+        # Audit seal at the fence (obs/audit.py): digest the closed
+        # epoch's causal surface while its log/ring windows are
+        # still resident (completion below truncates them), persist
+        # the ledger entry next to the checkpoint, and fan out on
+        # the epoch tracker's seal bus. The SOURCE_CHECKPOINT
+        # appends after the snapshot land past this epoch's window
+        # end, so the seal is fence-exact.
+        self._seal_and_trigger(
+            closed, lambda: self.executor.epoch_window(closed),
+            self.executor.lean_snapshot, phases, prof, async_write=False)
+        self._append_source_fence_determinant(closed, phases, prof)
+        for tl in self.txn_logs.values():
+            tl.seal(closed)
+        # Before completion: ack_all truncates rings up to this
+        # fence, so anything reading their fresh steps (edge
+        # exports) goes now.
+        for hook in self.fence_hooks:
+            hook(closed)
+        if complete_checkpoint:
+            self.coordinator.ack_all(closed)
+        fence_s = _time.monotonic() - t_fence
+        phases["fence-tail"] = fence_s * 1e3
+        self.last_fence_phases = phases
+        self._m_epoch_fence_ms.update(fence_s * 1e3)
+        tr.complete("epoch.fence", fence_s, epoch=closed)
+
+    def _check_fence_headroom(self) -> None:
+        """One epoch of ring headroom, asserted once: the pipelined
+        fence defers the overflow check to the NEXT fence, so the
+        in-flight rings must absorb one extra epoch of steps before
+        wrapping — otherwise a wrap inside the deferral window silently
+        clobbers the recovery state the check exists to protect.
+        Spill-enabled runs are exempt (ring overflow is the spill
+        tiers' concern, not the check's)."""
+        self._fence_headroom_checked = True
+        if self.executor.spill_logs is not None:
+            return
+        rings = self.executor.carry.out_rings
+        if not rings:
+            return
+        min_steps = min(r.ring_steps for r in rings)
+        spe = self.executor.steps_per_epoch
+        if min_steps < 2 * spe:
+            raise ValueError(
+                f"overlap_epoch needs one epoch of ring headroom: "
+                f"inflight_ring_steps={min_steps} < 2*steps_per_epoch="
+                f"{2 * spe} — raise inflight_ring_steps or use the "
+                f"sequential fence (overlap_epoch=False)")
+
+    def _begin_fence_tail(self, closed: int, complete_checkpoint: bool,
+                          prof) -> None:
+        """Capture this fence's state as device-side handles and hand
+        the tail to the single fence worker. Everything inside the
+        overlap window stays dispatch-only — no host synchronization
+        (lint rule overlap-window enforces it), so the next epoch's
+        compute can be dispatched immediately behind it."""
+        t = _time.monotonic()
+        phases: Dict[str, float] = {}
+        # clonos: overlap-window-begin
+        handles = self.executor.capture_fence(
+            with_window=self.auditor.enabled)
+        snap = self.executor.lean_snapshot()
+        self._append_source_fence_determinant(closed, phases, prof)
+        # clonos: overlap-window-end
+        for tl in self.txn_logs.values():
+            tl.seal(closed)
+        for hook in self.fence_hooks:
+            hook(closed)
+        pre_ms = (_time.monotonic() - t) * 1e3
+        phases["fence.capture"] = max(
+            0.0, pre_ms - phases.get("fence.source-append", 0.0))
+        tail = {"epoch": closed, "complete": complete_checkpoint,
+                "handles": handles, "snap": snap, "phases": phases,
+                "pre_ms": pre_ms, "vec": None, "err": None}
+        th = threading.Thread(target=self._fence_worker, args=(tail,),
+                              name="fence-tail", daemon=True)
+        tail["thread"] = th
+        self._fence_tail = tail
+        th.start()
+
+    def _fence_worker(self, tail: dict) -> None:
+        """Fence-tail drain, off the critical path: drain the async
+        health d2h, fold the host mirrors, advance the epoch control
+        plane, then seal + ledger + checkpoint from the captured
+        handles and make the snapshot durable (coordinator.drain before
+        exit). Errors are held and re-raised at the join; the overflow
+        check on the drained health vector is ALSO deferred to the join
+        — it must run on the main thread, like the checkpoint ack whose
+        completion listeners mutate executor state."""
+        from clonos_tpu.obs import profile as _prof_mod
+        closed = tail["epoch"]
+        phases = tail["phases"]
+        try:
+            t = _time.monotonic()
+            vec = tail["handles"].health()
+            phases["fence.health-read"] = (_time.monotonic() - t) * 1e3
+            tail["vec"] = vec
+            delta_records = self._absorb_fence_health(closed, vec)
+            self.epoch_tracker.inc_record_count(delta_records)
+            # By value, not executor.epoch_id: the main thread may have
+            # dispatched further epochs by the time this runs.
+            self.epoch_tracker.start_new_epoch(closed + 1)
+            self._seal_and_trigger(
+                closed, tail["handles"].window, lambda: tail["snap"],
+                phases, _prof_mod.NullProfiler(), async_write=True)
+        except BaseException as e:      # re-raised at the join
+            tail["err"] = e
+
+    def _join_fence_tail(self) -> None:
+        """Join the in-flight fence tail. Main thread only: the
+        deferred overflow check and the checkpoint ack — whose
+        completion listeners truncate logs/rings by replacing
+        ``executor.carry`` — must interleave with steps, never with
+        them. Also closes the tail's attribution: sub-spans keep their
+        true walls, ``fence-tail`` is the critical-path wall actually
+        paid (capture + join), and the difference is credited to
+        ``fence.overlap-saved``, preserving
+        sum(fence.*) - overlap-saved == fence-tail."""
+        tail = self._fence_tail
+        if tail is None:
+            return
+        self._fence_tail = None
+        t = _time.monotonic()
+        tail["thread"].join()
+        joined_ms = (_time.monotonic() - t) * 1e3
+        phases = tail["phases"]
+        tail_ms = tail["pre_ms"] + joined_ms
+        spans = sum(v for k, v in phases.items()
+                    if k.startswith("fence."))
+        saved = max(0.0, spans - tail_ms)
+        phases["fence-tail"] = tail_ms
+        phases["fence.overlap-saved"] = saved
+        self.fence_overlap_saved_total_ms += saved
+        self.last_fence_phases = phases
+        prof = self.profiler
+        for key, legacy in (("fence.health-read", "health-read"),
+                            ("fence.digest-seal", "digest-seal"),
+                            ("fence.ledger-write", "ledger-write"),
+                            ("fence.snapshot", "snapshot")):
+            if key in phases:
+                prof.observe(legacy, phases[key] / 1e3)
+        self._m_epoch_fence_ms.update(tail_ms)
+        get_tracer().complete("epoch.fence", tail_ms / 1e3,
+                              epoch=tail["epoch"])
+        if tail["err"] is not None:
+            raise tail["err"]
+        violations = self.executor.overflow_messages(tail["vec"])
+        if violations:
+            raise OverflowError_(
+                f"deferred fence check (pipelined fence, epoch "
+                f"{tail['epoch']}): " + "; ".join(violations))
+        if tail["complete"]:
+            self.coordinator.ack_all(tail["epoch"])
+
+    def fence_tail_in_flight(self) -> bool:
+        """True while a pipelined fence tail is still unjoined."""
+        return self._fence_tail is not None
+
+    def drain_fence(self) -> None:
+        """Settle the pipelined fence completely: join the in-flight
+        tail (running its deferred overflow check and checkpoint ack)
+        and wait out async checkpoint writes — after this, ledger,
+        completion, and truncation state match what a sequential run
+        would show at the same fence."""
+        self._join_fence_tail()
+        self.coordinator.drain()
 
     def step(self) -> None:
         if self.failed:
@@ -1547,6 +1802,14 @@ class ClusterRunner:
         in-flight output ring (the producer's subpartition log dies with
         the producer). (Fault-injection API the reference delegates to
         Jepsen, flink-jepsen/.)"""
+        # A kill landing mid-pipelined-fence DRAINS the in-flight seal
+        # deterministically: the tail belongs to an epoch every victim
+        # completed healthy, so joining it first (seal + ledger +
+        # checkpoint ack all land) makes the post-kill storage state a
+        # pure function of the kill point — recovery then sees either a
+        # completed fence or a cleanly pending one, never a half-sealed
+        # epoch.
+        self._join_fence_tail()
         carry = self.executor.carry
         nr = self.executor.compiled.plan.num_replicas
         for flat in flat_subtasks:
@@ -1618,6 +1881,9 @@ class ClusterRunner:
         ``finalize.listener-reattach``, not to the patch phase."""
         if not self.failed:
             raise rec.RecoveryError("no failed subtasks")
+        # Defensive: inject_failure already drains the pipelined fence,
+        # but recovery must never run against a half-sealed tail.
+        self._join_fence_tail()
         if not self.standbys.has_state():
             raise rec.RecoveryError(
                 "no completed checkpoint to restore standbys from")

@@ -553,6 +553,85 @@ def canonical_carry(carry: JobCarry) -> JobCarry:
         out_rings=tuple(_canon_ring(r) for r in carry.out_rings))
 
 
+def _slice_log_window(epoch: int, rows: np.ndarray, heads: np.ndarray,
+                      starts: np.ndarray) -> Dict[int, np.ndarray]:
+    """Slice one closed epoch's determinant rows out of the stacked
+    causal-log arrays (host side). Shared by the live fence path
+    (``epoch_window`` reading the resident carry) and the pipelined
+    fence's deferred drain (``FenceHandles`` reading captured device
+    copies), so both produce byte-identical audit-digest input."""
+    cap = rows.shape[1]
+    me = starts.shape[1]
+    logs: Dict[int, np.ndarray] = {}
+    for flat in range(rows.shape[0]):
+        s = int(starts[flat, epoch % me])
+        t = int(starts[flat, (epoch + 1) % me])
+        if t < s:               # next epoch's start not stamped yet
+            t = int(heads[flat])
+        pos = np.arange(s, t) & (cap - 1)
+        logs[flat] = np.ascontiguousarray(rows[flat][pos])
+    return logs
+
+
+def _slice_ring_window(epoch: int, keys: np.ndarray, values: np.ndarray,
+                       stamps: np.ndarray, valid: np.ndarray,
+                       estarts: np.ndarray, head: int) -> list:
+    """Per-step valid records of one output ring for one closed epoch,
+    in the deterministic (lane, slot) order — the ring half of
+    :func:`_slice_log_window`'s shared-extraction contract."""
+    rme = estarts.shape[0]
+    s = int(estarts[epoch % rme])
+    t = int(estarts[(epoch + 1) % rme])
+    if t < s:
+        t = int(head)
+    rcap = keys.shape[0]
+    steps = []
+    for step in range(s, t):
+        p = step & (rcap - 1)
+        m = valid[p]
+        steps.append((keys[p][m], values[p][m], stamps[p][m]))
+    return steps
+
+
+class FenceHandles:
+    """Device-side capture of one closed epoch's fence surface — the
+    health vector plus (optionally) the causal-log / in-flight-ring
+    window arrays the audit seal digests. Produced by
+    :meth:`LocalExecutor.capture_fence` as deep device copies with d2h
+    started asynchronously, so the pipelined fence can dispatch the
+    next epoch's compute immediately and let a worker thread drain the
+    handles off the critical path. The handles never alias the live
+    carry (whose buffers are donated into later block programs)."""
+
+    def __init__(self, epoch: int, health, window, ring_index):
+        self.epoch = epoch
+        self._health = health
+        self._window = window
+        self._ring_index = ring_index
+
+    def health(self) -> np.ndarray:
+        """Drain the fused health vector (blocks until the capture
+        program and its async d2h complete)."""
+        return np.asarray(self._health)
+
+    def window(self) -> Optional[Dict[str, Any]]:
+        """Drain the captured causal surface into the exact
+        ``epoch_window`` dict shape (None when captured without one)."""
+        if self._window is None:
+            return None
+        rows, heads, starts, rings_t = self._window
+        logs = _slice_log_window(self.epoch, np.asarray(rows),
+                                 np.asarray(heads), np.asarray(starts))
+        rings: Dict[int, list] = {}
+        for vid, ri in self._ring_index.items():
+            keys, values, stamps, valid, estarts, head = rings_t[ri]
+            rings[vid] = _slice_ring_window(
+                self.epoch, np.asarray(keys), np.asarray(values),
+                np.asarray(stamps), np.asarray(valid),
+                np.asarray(estarts), int(np.asarray(head)))
+        return {"logs": logs, "rings": rings}
+
+
 class CausalTimeSource:
     """Host clock for the live path (reference CausalTimeService /
     PeriodicCausalTimeService.java — one amortized read per superstep).
@@ -1127,39 +1206,16 @@ class LocalExecutor:
         closes it and for every epoch at/after the latest completed
         checkpoint during recovery."""
         c = self.carry
-        rows = np.asarray(c.logs.rows)
-        heads = np.asarray(c.logs.head)
-        starts = np.asarray(c.logs.epoch_starts)
-        cap = rows.shape[1]
-        me = starts.shape[1]
-        logs: Dict[int, np.ndarray] = {}
-        for flat in range(rows.shape[0]):
-            s = int(starts[flat, epoch % me])
-            t = int(starts[flat, (epoch + 1) % me])
-            if t < s:               # next epoch's start not stamped yet
-                t = int(heads[flat])
-            pos = np.arange(s, t) & (cap - 1)
-            logs[flat] = np.ascontiguousarray(rows[flat][pos])
+        logs = _slice_log_window(
+            epoch, np.asarray(c.logs.rows), np.asarray(c.logs.head),
+            np.asarray(c.logs.epoch_starts))
         rings: Dict[int, list] = {}
         for vid, ri in self.compiled.ring_index.items():
             el = c.out_rings[ri]
-            keys = np.asarray(el.keys)
-            values = np.asarray(el.values)
-            stamps = np.asarray(el.timestamps)
-            valid = np.asarray(el.valid)
-            estarts = np.asarray(el.epoch_starts)
-            rme = estarts.shape[0]
-            s = int(estarts[epoch % rme])
-            t = int(estarts[(epoch + 1) % rme])
-            if t < s:
-                t = int(el.head)
-            rcap = keys.shape[0]
-            steps = []
-            for step in range(s, t):
-                p = step & (rcap - 1)
-                m = valid[p]
-                steps.append((keys[p][m], values[p][m], stamps[p][m]))
-            rings[vid] = steps
+            rings[vid] = _slice_ring_window(
+                epoch, np.asarray(el.keys), np.asarray(el.values),
+                np.asarray(el.timestamps), np.asarray(el.valid),
+                np.asarray(el.epoch_starts), int(el.head))
         return {"logs": logs, "rings": rings}
 
     def _health_vector(self, carry: JobCarry) -> jnp.ndarray:
@@ -1398,6 +1454,42 @@ class LocalExecutor:
                     ring_heads=tuple(cp(r.head) for r in c.out_rings))
             self._jit_snap = jax.jit(_snap)
         return self._jit_snap(self.carry)
+
+    def capture_fence(self, with_window: bool = True) -> FenceHandles:
+        """Capture the fence surface of the epoch that just closed as
+        cheap device-side handles: ONE jitted deep-copy program (the
+        fused health vector plus, when the audit seal needs it, the
+        causal-log and ring window arrays), then a non-blocking
+        ``copy_to_host_async`` on every output. The pipelined fence
+        calls this before dispatching the next epoch's compute; the
+        fence worker drains the handles into host arrays later without
+        touching the live carry. Must run at the fence (right after
+        ``run_epoch`` rolls), while ``epoch_starts[closed+1]`` is
+        stamped and no new-epoch rows have landed."""
+        key = bool(with_window)
+        if not hasattr(self, "_jit_capture"):
+            self._jit_capture = {}
+        if key not in self._jit_capture:
+            def _cap(c: JobCarry):
+                cp = lambda t: jax.tree_util.tree_map(
+                    lambda x: jnp.asarray(x).copy(), t)
+                health = self._health_vector(c)
+                if not key:
+                    return health, None
+                window = (
+                    cp(c.logs.rows), cp(c.logs.head),
+                    cp(c.logs.epoch_starts),
+                    tuple((cp(el.keys), cp(el.values), cp(el.timestamps),
+                           cp(el.valid), cp(el.epoch_starts), cp(el.head))
+                          for el in c.out_rings))
+                return health, window
+            self._jit_capture[key] = jax.jit(_cap)
+        health, window = self._jit_capture[key](self.carry)
+        for leaf in jax.tree_util.tree_leaves((health, window)):
+            if hasattr(leaf, "copy_to_host_async"):
+                leaf.copy_to_host_async()
+        return FenceHandles(self.epoch_id - 1, health, window,
+                            dict(self.compiled.ring_index))
 
     def restore(self, carry_host, epoch_id: int) -> None:
         """Adopt a checkpointed carry (standby restore path; reference

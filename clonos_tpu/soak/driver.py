@@ -85,6 +85,11 @@ class SoakHarness:
         #: job resumes, not at the next fence).
         self.kill_overlap_saved_ms: List[float] = []
         self.kill_rediff_problems = 0
+        #: kills that landed while the PIPELINED fence tail (seal /
+        #: ledger / checkpoint on the fence worker) was still in
+        #: flight — inject_failure joins the tail first, so each such
+        #: kill proves the drain ordering under fire.
+        self.kills_mid_fence_tail = 0
 
     # --- fault application ---------------------------------------------------
 
@@ -365,6 +370,9 @@ class SoakDriver:
         # deployed-standby analog: recovery programs compile off the
         # paced clock, so the first kill measures the protocol
         r.prewarm_recovery()
+        # pipelined fence: _last_records_total is absorbed on the fence
+        # worker — join any in-flight warmup tail before reading it
+        r.drain_fence()
         if self.records_per_step is None:
             self.records_per_step = max(
                 1, r._last_records_total // max(r.global_step, 1))
@@ -461,19 +469,50 @@ class SoakDriver:
                     # nothing pending either, or a kill in the backlog
                     # window appends IGNORE determinants the control
                     # twin never sees (digest divergence by design,
-                    # not by bug)
+                    # not by bug). Pipelined fence: the in-flight tail
+                    # is still creating this epoch's pending — join it
+                    # first or the discard races the worker's trigger.
+                    r.drain_fence()
                     r.coordinator.discard_pending_through(
                         ex.epoch_id - 1)
                 if complete:
-                    # abandon OLDER skipped fences' checkpoints: a
-                    # completing fence must leave nothing pending, or
-                    # the next kill's recovery ignores them and the
-                    # IGNORE determinants diverge from the control
-                    r.coordinator.discard_pending_through(
-                        ex.epoch_id - 1)
+                    if pending_kills and r.fence_tail_in_flight():
+                        # kill MID-fence-tail: abandon only the OLDER
+                        # skipped checkpoints (sparing the in-flight
+                        # epoch's), then fire the kill NOW, while the
+                        # seal/ledger/checkpoint tail is still on the
+                        # fence worker. inject_failure joins the tail
+                        # first, so the seal and ack complete, nothing
+                        # is pending at kill time, and recovery appends
+                        # no IGNORE determinants — the digest chain
+                        # stays byte-comparable with the control twin.
+                        r.coordinator.discard_pending_through(
+                            ex.epoch_id - 2)
+                        h.kills_mid_fence_tail += len(pending_kills)
+                        for ev in pending_kills:
+                            h.apply(ev, now_s)
+                            self.slo.observe_fault(now_s, ev.kind)
+                            if h.recoveries_ms:
+                                self.slo.observe_recovery(
+                                    now_s, h.recoveries_ms[-1])
+                        pending_kills.clear()
+                    else:
+                        # abandon OLDER skipped fences' checkpoints: a
+                        # completing fence must leave nothing pending,
+                        # or the next kill's recovery ignores them and
+                        # the IGNORE determinants diverge from the
+                        # control. Join the in-flight tail first — its
+                        # ack (completion) lands at the join.
+                        r.drain_fence()
+                        r.coordinator.discard_pending_through(
+                            ex.epoch_id - 1)
                     force_complete = False
                     kill_armed = bool(pending_kills)
                 if h.audit_pending:
+                    # the fence worker may be mid seal -> ledger
+                    # append; diffing now would report a false
+                    # missing-entry divergence
+                    r.drain_fence()
                     h.audit_check()
                     h.audit_pending = False
             h.tick(now_s)
@@ -492,6 +531,7 @@ class SoakDriver:
             due.clear()
         if pending_kills:
             r.run_epoch(complete_checkpoint=True)
+            r.drain_fence()
             r.coordinator.discard_pending_through(ex.epoch_id - 1)
             for _ in range(cfg.chunk_steps):
                 r.step()
@@ -503,6 +543,7 @@ class SoakDriver:
                                               h.recoveries_ms[-1])
         h.tick(float("inf"))
         r.run_epoch(complete_checkpoint=True)
+        r.drain_fence()      # final sweep must see every in-flight seal
         h.audit_check()
         wall_s = _time.monotonic() - t0
         return self._verdict(wall_s, sent_records, ei)
@@ -555,6 +596,10 @@ class SoakDriver:
                 # left bit-identical state).
                 "kill_overlap_saved_ms": list(h.kill_overlap_saved_ms),
                 "kill_rediff_problems": h.kill_rediff_problems,
+                # kills fired while the pipelined fence tail was still
+                # in flight (inject joins it first): each one exercised
+                # the kill-mid-seal drain ordering under load.
+                "kills_mid_fence_tail": h.kills_mid_fence_tail,
             },
             "audit": {
                 "enabled": audited,
@@ -601,7 +646,8 @@ def build_soak_fixture(workdir: str, rate: float, duration_s: float,
                        steps_per_epoch: int = 64, par: int = 2,
                        batch: int = 8, seed: int = 11,
                        audit: bool = True, lease_ttl_s: float = 2.0,
-                       num_keys: int = 101):
+                       num_keys: int = 101,
+                       overlap_epoch: bool = False):
     """Construct the soak trio: runner, fault-free control twin, and a
     held leader lease — same job, same seed, logical time on BOTH
     runners (digest chains are only byte-comparable across runs when
@@ -635,15 +681,19 @@ def build_soak_fixture(workdir: str, rate: float, duration_s: float,
     log_capacity = 1 << (2 * span * DETS_PER_STEP).bit_length()
     ring_steps = 1 << (span - 1).bit_length()
 
-    def runner_for(sub):
+    def runner_for(sub, overlap=False):
         return ClusterRunner(
             build(), steps_per_epoch=steps_per_epoch,
             log_capacity=log_capacity, max_epochs=max_epochs,
             inflight_ring_steps=ring_steps,
             checkpoint_dir=os.path.join(workdir, sub),
-            audit=audit, logical_time=True, seed=seed)
+            audit=audit, logical_time=True, seed=seed,
+            overlap_epoch=overlap)
 
-    runner = runner_for("run")
+    # Only the soak runner pipelines its fence; the control twin stays
+    # strictly sequential, so the ledger diff is always overlapped-vs-
+    # sequential — the strongest bit-identity witness available.
+    runner = runner_for("run", overlap_epoch)
     control = runner_for("control") if audit else None
     election = FileLeaderElection(os.path.join(workdir, "lease"),
                                   "soak-driver", lease_ttl_s=lease_ttl_s)
