@@ -673,6 +673,154 @@ def soak_probe(duration_s: float = 30.0):
     }
 
 
+def serve_probe(duration_s: float = 20.0):
+    """Read-path probe (``bench.py --serve [SECONDS]``): prices the
+    read tier (runtime/serve.py) honestly, one JSON line.
+
+    1. **Batched vs sequential** at the headline 32-subtask shape: the
+       same lookups issued as sequential point queries and as batched
+       reads against a tailed replica (one coalesced jitted gather per
+       device dispatch). The acceptance bar is >= 5x.
+    2. **Bit-identity**: replica-served values vs owner-served values
+       for the same keys at the same epoch stamp — must match exactly.
+    3. **Mixed load + degradation**: the soak driver pumps routed reads
+       between ingest chunks with read-latency SLO windows, and a
+       ``replica-kill`` chaos event mid-run must degrade (re-route to
+       owner, staleness spike then recovery) with ZERO client-visible
+       errors, audit still clean."""
+    import gc
+    import tempfile
+
+    from clonos_tpu.runtime.cluster import ClusterRunner
+    from clonos_tpu.runtime.executor import DETS_PER_STEP
+    from clonos_tpu.runtime.serve import build_serve_tier
+    from clonos_tpu.soak import (ServeLoad, SLOSpec, SoakConfig,
+                                 SoakDriver, build_soak_fixture,
+                                 parse_schedule)
+
+    def reduce_vid(job):
+        return next(v.vertex_id for v in job.vertices
+                    if getattr(v.operator, "emits_running_value", False))
+
+    # -- part 1+2: batched vs sequential + bit-identity, 32 subtasks --
+    SPE = int(os.environ.get("BENCH_SERVE_SPE", 256))
+    N_SEQ = int(os.environ.get("BENCH_SERVE_SEQ_READS", 256))
+    N_BATCH = int(os.environ.get("BENCH_SERVE_BATCH_READS", 4096))
+    CHUNK = 256
+    job = build_job()
+    vid = reduce_vid(job)
+    runner = ClusterRunner(
+        job, steps_per_epoch=SPE,
+        log_capacity=1 << (2 * SPE * DETS_PER_STEP).bit_length(),
+        max_epochs=16,
+        inflight_ring_steps=1 << (2 * SPE - 1).bit_length(),
+        block_steps=min(256, SPE), seed=7)
+    # tier FIRST: replicas subscribe to the serve feed before any epoch
+    # seals, so they tail every fence from the start
+    tier = build_serve_tier(runner, vid, n_replicas=2)
+    for _ in range(3):
+        runner.run_epoch(complete_checkpoint=True)
+    runner.drain_fence()
+    rng = np.random.RandomState(13)
+    rep = tier.clients[0]
+    # warm the gather compile off the measured clock
+    rep.query_batch(vid, [0])
+    rep.query(vid, 0)
+    keys_seq = rng.randint(0, 997, N_SEQ)
+    t0 = time.monotonic()
+    seq_out = [rep.query(vid, int(k)) for k in keys_seq]
+    seq_s = time.monotonic() - t0
+    keys_b = rng.randint(0, 997, N_BATCH)
+    t0 = time.monotonic()
+    batch_epochs = []
+    batch_vals = {}
+    for i in range(0, N_BATCH, CHUNK):
+        chunk = [int(k) for k in keys_b[i:i + CHUNK]]
+        out = rep.query_batch(vid, chunk)
+        batch_epochs.append(out["epoch"])
+        batch_vals.update(zip(chunk, out["values"]))
+    batch_s = time.monotonic() - t0
+    qps_seq = N_SEQ / seq_s if seq_s else 0.0
+    qps_batch = N_BATCH / batch_s if batch_s else 0.0
+    speedup = qps_batch / qps_seq if qps_seq else 0.0
+    # bit-identity vs the owner at the same epoch stamp
+    probe_keys = sorted(batch_vals)
+    own = tier.owner_client.query_batch(vid, probe_keys)
+    same_epoch = (own["epoch"] == batch_epochs[-1]
+                  and all(e == own["epoch"] for e in batch_epochs))
+    mismatches = [int(k) for k, ov in zip(probe_keys, own["values"])
+                  if batch_vals[k] != ov]
+    # point reads must agree with batched reads too
+    point_ok = all(o["value"] == batch_vals.get(int(k), o["value"])
+                   for k, o in zip(keys_seq, seq_out))
+    replica_status = [r.status() for r in tier.replicas]
+    dispatches = [ep.dispatches for ep in tier.endpoints]
+    keys_served = [ep.keys_served for ep in tier.endpoints]
+    tier.close()
+    del runner, job
+    gc.collect()
+
+    # -- part 3: mixed read/ingest load with a replica-kill mid-run --
+    rate = float(os.environ.get("BENCH_SERVE_RATE", 2000))
+    slo_ms = float(os.environ.get("BENCH_SERVE_SLO_MS", 2000))
+    with tempfile.TemporaryDirectory() as td:
+        srun, control, election = build_soak_fixture(
+            td, rate=rate, duration_s=duration_s, seed=11,
+            overlap_epoch=True, serve_vertex=True)
+        svid = reduce_vid(srun.job)
+        stier = build_serve_tier(srun, svid, n_replicas=2,
+                                 staleness_bound=2)
+        load = ServeLoad(stier, svid, num_keys=101,
+                         reads_per_pump=32, slo_ms=slo_ms)
+        kill_at = round(0.4 * duration_s, 1)
+        schedule = parse_schedule(f"at {kill_at}s replica-kill 0")
+        driver = SoakDriver(
+            srun, SoakConfig(rate=rate, duration_s=duration_s),
+            schedule=schedule, spec=SLOSpec(),
+            control=control, election=election, read_load=load)
+        v = driver.run()
+        stier.close()
+    serve = v["serve"]
+    audit_ok = bool(v["audit"]["exactly_once"])
+    degraded_not_failed = (serve["errors"] == 0
+                           and serve["reroutes"] > 0
+                           and serve["staleness_peak"]
+                           > serve["staleness_final"])
+
+    return {
+        "metric": "serve_batched_read_speedup",
+        "value": round(speedup, 2),
+        "unit": "batched replica reads vs sequential point queries "
+                "(same keys, 32-subtask shape)",
+        "pass": bool(speedup >= 5.0 and same_epoch and not mismatches
+                     and point_ok and serve["ok"]
+                     and degraded_not_failed and audit_ok),
+        "read_qps_sequential": round(qps_seq, 1),
+        "read_qps_batched": round(qps_batch, 1),
+        "sequential_reads": N_SEQ,
+        "batched_reads": N_BATCH,
+        "batch_chunk": CHUNK,
+        "device_dispatches": dispatches,
+        "keys_served": keys_served,
+        "bit_identical_vs_owner": not mismatches,
+        "bit_identity_keys_checked": len(probe_keys),
+        "bit_identity_mismatched_keys": mismatches[:8],
+        "same_epoch_stamp": same_epoch,
+        "point_vs_batch_consistent": point_ok,
+        "replica_status": replica_status,
+        "mixed_load": {
+            "ingest_rate_target": v["rate_target"],
+            "ingest_rate_achieved": v["rate_achieved"],
+            "ingest_p99_ms": v["latency"]["p99_ms"],
+            "serve": serve,
+            "degraded_not_failed": degraded_not_failed,
+            "audit": v["audit"],
+            "schedule": v["schedule"],
+        },
+        "census_fingerprint": v.get("census_fingerprint"),
+    }
+
+
 def spill_probe():
     """Tiered-storage probe (``bench.py --spill``): prices the spill
     fabric (clonos_tpu/storage/) three ways, one JSON line.
@@ -830,7 +978,19 @@ def spill_probe():
 
 
 def main(jobs=None, multichip=None, soak=None, ablate=False,
-         spill=False):
+         spill=False, serve=None):
+    if serve:
+        # --serve [SECONDS]: run ONLY the read-path probe (one JSON
+        # line, same contract as the headline bench) and persist it as
+        # the next free SERVE_r0N.json artifact.
+        from clonos_tpu.soak import next_serve_artifact_path
+        out = serve_probe(float(serve))
+        path = next_serve_artifact_path()
+        with open(path, "w") as f:
+            json.dump(out, f, indent=1)
+        out["artifact"] = os.path.basename(path)
+        print(json.dumps(out))
+        return 0 if out["pass"] else 1
     if spill:
         # --spill: run ONLY the tiered-storage probe (one JSON line,
         # same contract as the headline bench).
@@ -1281,6 +1441,13 @@ if __name__ == "__main__":
                          "throughput spill on vs off + deep-backlog "
                          "disk-tier recovery, audit-verified) instead "
                          "of the headline bench")
+    ap.add_argument("--serve", type=float, nargs="?", const=20.0,
+                    default=None, metavar="SECONDS",
+                    help="run the read-path probe (batched replica "
+                         "reads vs sequential point queries, "
+                         "bit-identity vs the owner, mixed read/ingest "
+                         "load with a replica-kill) instead of the "
+                         "headline bench; writes SERVE_r0N.json")
     _a = ap.parse_args()
     sys.exit(main(jobs=_a.jobs, multichip=_a.multichip, soak=_a.soak,
-                  ablate=_a.ablate, spill=_a.spill))
+                  ablate=_a.ablate, spill=_a.spill, serve=_a.serve))

@@ -82,6 +82,15 @@ class Operator:
     #: must set this False and accept one tail-shape compile instead.
     replay_pad_safe: bool = True
 
+    #: Running-value contract: True iff every VALID output record carries
+    #: the operator's updated keyed state for that record's key (Flink
+    #: reduce semantics). Read replicas (runtime/serve.py) tail such
+    #: operators to fence freshness by last-write-wins scatter of each
+    #: sealed epoch's output ring — bit-identical to the owner's fence
+    #: state by construction. Operators without the property fall back
+    #: to checkpoint-only freshness on the read path.
+    emits_running_value: bool = False
+
     def init_state(self, parallelism: int) -> Any:
         return ()
 
@@ -291,6 +300,9 @@ class KeyedReduceOperator(Operator):
     num_keys: int
     reduce_fn: Callable[[jnp.ndarray, jnp.ndarray], jnp.ndarray] = jnp.add
     init_value: int = 0
+    # out_vals = new_acc[b.keys] for valid records below — the running
+    # value — so read replicas can tail this operator's output rings.
+    emits_running_value = True
 
     def init_state(self, parallelism: int):
         return {"acc": jnp.full((parallelism, self.num_keys), self.init_value,

@@ -145,11 +145,12 @@ def cmd_info(args) -> int:
 
 def cmd_bench(args) -> int:
     import bench
-    bench.main(jobs=getattr(args, "jobs", None),
-               multichip=getattr(args, "multichip", None),
-               soak=getattr(args, "soak", None),
-               ablate=getattr(args, "ablate", False))
-    return 0
+    rc = bench.main(jobs=getattr(args, "jobs", None),
+                    multichip=getattr(args, "multichip", None),
+                    soak=getattr(args, "soak", None),
+                    ablate=getattr(args, "ablate", False),
+                    serve=getattr(args, "serve", None))
+    return int(rc or 0)
 
 
 def cmd_dryrun(args) -> int:
@@ -623,6 +624,22 @@ def _top_table(snap) -> str:
         lines.append("")
         lines.append("soak: " + "  ".join(
             f"{k}={v}" for k, v in sorted(soak.items())))
+    # Serve status row: the read tier's serve.* gauges (read QPS, p99
+    # read latency, per-replica staleness-epochs, reroutes) — same
+    # suffix matching, so the row survives a worker.<eid> prefix on
+    # metrics that rode a HEARTBEAT into cluster_metrics().
+    serve = {}
+    for k, v in sorted(snap.items()):
+        if not isinstance(v, (int, float)) or isinstance(v, bool):
+            continue
+        if k.startswith("serve."):
+            serve[k[len("serve."):]] = v
+        elif ".serve." in k:
+            serve.setdefault(k.rsplit(".serve.", 1)[1], v)
+    if serve:
+        lines.append("")
+        lines.append("serve: " + "  ".join(
+            f"{k}={v}" for k, v in sorted(serve.items())))
     tenant = {k: v for k, v in sorted(snap.items())
               if (k.startswith("tenant.")
                   or k.startswith("dispatcher."))
@@ -1074,6 +1091,13 @@ def main(argv=None) -> int:
                          "fixed-rate load + seeded chaos + exactly-"
                          "once audit (see `clonos_tpu soak` for the "
                          "full-control version)")
+    pb.add_argument("--serve", type=float, nargs="?", const=20.0,
+                    default=None, metavar="SECONDS",
+                    help="run ONLY the read-path probe: batched "
+                         "replica reads vs sequential point queries, "
+                         "bit-identity vs the owner, and mixed "
+                         "read/ingest load with a replica-kill "
+                         "(writes SERVE_r0N.json)")
     pb.add_argument("--ablate", action="store_true",
                     help="run ONLY the no-FT ablation probe: the "
                          "semantics-preserving twin head-to-head "

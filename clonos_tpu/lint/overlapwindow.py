@@ -1,15 +1,24 @@
-"""Rule family 5: host synchronization inside the pipelined-fence
-overlap window.
+"""Rule family 5: host synchronization inside marked dispatch-only
+windows.
 
-The pipelined fence (runtime/cluster.py ``_begin_fence_tail``) promises
-that everything between its ``# clonos: overlap-window-begin`` /
-``# clonos: overlap-window-end`` markers is DISPATCH-ONLY: device
-programs and async d2h starts, never a host block. One stray
-``np.asarray`` / ``jax.block_until_ready`` there silently re-serializes
-the exact tail the pipeline exists to hide — the steady-state headline
-regresses with no functional symptom, which is why this is a lint rule
-and not a test. The async-safe primitive ``copy_to_host_async`` is
-explicitly allowed; its blocking cousins are not.
+Two window families share the machinery:
+
+- The pipelined fence (runtime/cluster.py ``_begin_fence_tail``)
+  promises that everything between its ``# clonos:
+  overlap-window-begin`` / ``# clonos: overlap-window-end`` markers is
+  DISPATCH-ONLY: device programs and async d2h starts, never a host
+  block. One stray ``np.asarray`` / ``jax.block_until_ready`` there
+  silently re-serializes the exact tail the pipeline exists to hide.
+
+- The batched read path (runtime/serve.py ``_dispatch``) makes the
+  twin promise for serving: the region between ``# clonos:
+  serve-window-begin`` / ``# clonos: serve-window-end`` holds ONE fused
+  gather dispatch for the whole coalesced key batch. A blocking host
+  sync inside it re-serializes the batch back into the N round-trips
+  the coalescing queue exists to avoid — the read-path headline
+  regresses with no functional symptom, which is why both are lint
+  rules and not tests. The async-safe primitive ``copy_to_host_async``
+  is explicitly allowed; its blocking cousins are not.
 """
 
 from __future__ import annotations
@@ -22,6 +31,8 @@ from clonos_tpu.lint.core import (FileContext, Finding, Rule,
 
 BEGIN = "clonos: overlap-window-begin"
 END = "clonos: overlap-window-end"
+SERVE_BEGIN = "clonos: serve-window-begin"
+SERVE_END = "clonos: serve-window-end"
 
 #: canonical dotted names that force a host synchronization.
 SYNC_CALLS = {
@@ -35,34 +46,35 @@ SYNC_CALLS = {
 SYNC_ATTRS = {"block_until_ready", "copy_to_host", "item", "tolist"}
 
 
-def _windows(ctx: FileContext) -> List[tuple]:
-    """(begin_line, end_line) pairs of every marked overlap window."""
+def _windows(ctx: FileContext, begin: str = BEGIN,
+             end: str = END) -> List[tuple]:
+    """(begin_line, end_line) pairs of every marked window."""
     out, start = [], None
     for i, ln in enumerate(ctx.lines, start=1):
-        if BEGIN in ln:
+        if begin in ln:
             start = i
-        elif END in ln and start is not None:
+        elif end in ln and start is not None:
             out.append((start, i))
             start = None
     return out
 
 
-@register_rule
-class OverlapWindowSyncRule(Rule):
-    name = "overlap-window"
-    description = ("host synchronization (np.asarray / "
-                   "block_until_ready / device_get) inside a pipelined-"
-                   "fence overlap window — re-serializes the hidden tail")
+class _DispatchOnlyWindowRule(Rule):
+    """Shared checker: flag blocking host syncs between a marker pair,
+    plus unbalanced markers (an unclosed begin leaves its window
+    silently unchecked)."""
+
+    begin: str
+    end: str
+    window_desc: str
 
     def check(self, ctx: FileContext) -> List[Finding]:
-        wins = _windows(ctx)
+        wins = _windows(ctx, self.begin, self.end)
         out: List[Finding] = []
-        # an unclosed begin marker is itself a finding: the window it
-        # was supposed to bound is silently unchecked.
-        opens = sum(BEGIN in ln for ln in ctx.lines)
+        opens = sum(self.begin in ln for ln in ctx.lines)
         if opens != len(wins):
             out.append(self.finding(
-                ctx, 1, "unbalanced overlap-window markers "
+                ctx, 1, f"unbalanced {self.name} markers "
                         f"({opens} begin / {len(wins)} closed)"))
         if not wins:
             return out
@@ -85,8 +97,31 @@ class OverlapWindowSyncRule(Rule):
             seen.add((line, hit))
             out.append(self.finding(
                 ctx, line,
-                f"`{hit}` blocks on device results inside the "
-                f"pipelined-fence overlap window — keep the window "
-                f"dispatch-only (copy_to_host_async is the async "
-                f"primitive), or move the read to the fence worker"))
+                f"`{hit}` blocks on device results inside "
+                f"{self.window_desc} — keep the window dispatch-only "
+                f"(copy_to_host_async is the async primitive), or move "
+                f"the read outside the markers"))
         return out
+
+
+@register_rule
+class OverlapWindowSyncRule(_DispatchOnlyWindowRule):
+    name = "overlap-window"
+    description = ("host synchronization (np.asarray / "
+                   "block_until_ready / device_get) inside a pipelined-"
+                   "fence overlap window — re-serializes the hidden tail")
+    begin = BEGIN
+    end = END
+    window_desc = ("the pipelined-fence overlap window")
+
+
+@register_rule
+class ServeWindowSyncRule(_DispatchOnlyWindowRule):
+    name = "serve-window"
+    description = ("host synchronization inside a batched-read serve "
+                   "window — re-serializes the coalesced gather back "
+                   "into per-key round-trips")
+    begin = SERVE_BEGIN
+    end = SERVE_END
+    window_desc = ("a batched-read serve window (one fused gather per "
+                   "device dispatch)")

@@ -58,6 +58,13 @@ EDGE_DATA = 20             # payload = JSON header | int32 record rows
 SUBMIT_JOB = 21            # client -> Dispatcher: JobGraph + tenant config
 JOB_STATUS = 22            # client -> Dispatcher: one job / list all jobs
 CANCEL_JOB = 23            # client -> Dispatcher: cancel / abandon a job
+# read-path serving surface (runtime/serve.py): a replica endpoint
+# coalesces concurrent point lookups into ONE batched device gather per
+# dispatch; SERVE_STATUS is the router's cheap freshness probe
+# (epoch + staleness, no state read).
+QUERY_BATCH = 24           # client -> serve endpoint: many keys, one gather
+QUERY_BATCH_RESPONSE = 25
+SERVE_STATUS = 26          # client -> serve endpoint: epoch/staleness probe
 
 
 def _send(sock: socket.socket, mtype: int, payload: bytes) -> None:

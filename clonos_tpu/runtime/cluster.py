@@ -341,6 +341,18 @@ class ClusterRunner:
         # edge export (runtime/scheduler.py) must snapshot the producer
         # rings' fresh steps or lose them to the truncation.
         self.fence_hooks: List = []
+        #: read-replica delta feeds (runtime/serve.py): ``fn(epoch,
+        #: window)`` fires when an epoch seals, with the SAME extracted
+        #: causal-surface window the audit digests — standbys tail it to
+        #: keep their restored checkpoint fence-fresh. Runs on the fence
+        #: worker when the fence is pipelined: subscribers must be
+        #: host-only and thread-safe, like the auditor.
+        self.serve_feeds: List = []
+        #: the last epoch whose fence tail SEALED (digest when audit is
+        #: on, fence persistence either way) — the freshness stamp every
+        #: queryable-state snapshot carries. -1 until the first seal:
+        #: endpoints reject reads rather than serve an unstamped view.
+        self.last_sealed_epoch = -1
         self.global_step = 0
         self._fence_step: Dict[int, int] = {}   # epoch -> global step at start
         self._fence_step[0] = 0
@@ -1501,11 +1513,17 @@ class ClusterRunner:
         ``window_fn``/``snap_fn`` abstract WHERE the state comes from —
         the live carry (sequential) or captured device handles
         (pipelined) — so the digests are byte-identical either way."""
+        # One window extraction feeds BOTH planes: the audit digest and
+        # the read-replica delta feeds (runtime/serve.py) read the same
+        # causal surface, so a serving-only run (audit off) still pays
+        # exactly one extraction and a dual run pays no second one.
+        win = (window_fn()
+               if self.auditor.enabled or self.serve_feeds else None)
         if self.auditor.enabled:
             from clonos_tpu.obs import audit as _audit_mod
             t = _time.monotonic()
             with prof.section("digest-seal"):
-                dg = _audit_mod.digest_epoch_window(closed, window_fn())
+                dg = _audit_mod.digest_epoch_window(closed, win)
                 self.auditor.seal(dg)
             phases["fence.digest-seal"] = (_time.monotonic() - t) * 1e3
             t = _time.monotonic()
@@ -1519,6 +1537,16 @@ class ClusterRunner:
                 self.executor.attach_spill_digests(closed, dg)
             self.epoch_tracker.notify_epoch_sealed(closed, dg)
             self._m_audit_sealed.inc()
+        # The seal stamp advances in both modes — the fence tail IS the
+        # seal event queryable-state freshness is measured against.
+        # max(): the pipelined fence may run this on the worker while a
+        # drain-ordering edge case replays an older epoch's tail.
+        self.last_sealed_epoch = max(self.last_sealed_epoch, closed)
+        if self.serve_feeds:
+            t = _time.monotonic()
+            for fn in list(self.serve_feeds):
+                fn(closed, win)
+            phases["fence.serve-feed"] = (_time.monotonic() - t) * 1e3
         # Checkpoint at the fence: the lean fence snapshot (op state
         # + offsets; logs/rings are truncated on completion, not
         # persisted).
@@ -1639,7 +1667,7 @@ class ClusterRunner:
         phases: Dict[str, float] = {}
         # clonos: overlap-window-begin
         handles = self.executor.capture_fence(
-            with_window=self.auditor.enabled)
+            with_window=self.auditor.enabled or bool(self.serve_feeds))
         snap = self.executor.lean_snapshot()
         self._append_source_fence_determinant(closed, phases, prof)
         # clonos: overlap-window-end

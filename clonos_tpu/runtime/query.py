@@ -9,15 +9,29 @@ server thread must not touch device state, so the endpoint serves a
 FENCE SNAPSHOT the main loop refreshes (``refresh()`` at epoch
 boundaries — the same discipline as HostLogEndpoint). A lookup resolves
 the key's owning subtask with the SAME key-group assignment the exchange
-uses, so the served value is exactly the owning task's table entry. The
-snapshot is epoch-stamped: clients see which fence their read is from
-(the reference's client reads are similarly only
-checkpoint-consistent)."""
+uses, so the served value is exactly the owning task's table entry.
+
+Freshness contract (shared with the read-replica tier,
+runtime/serve.py): every snapshot is stamped with the runner's **last
+sealed epoch** — the epoch whose fence tail (audit seal + checkpoint
+trigger) has completed — not the executor's live epoch counter, which
+advances the moment the next epoch's compute is dispatched. Reads are
+REJECTED until the first seal lands: an unstamped snapshot has no
+consistency point to promise. Every response carries ``(epoch,
+staleness_epochs)`` so clients can see exactly which fence they read.
+
+The client side owns liveness: ``QueryableStateClient.query`` takes a
+per-request timeout with bounded exponential backoff and raises a typed
+:class:`QueryTimeoutError` when the budget is exhausted — a hung
+endpoint costs the caller a bounded wait, never a wedge.
+"""
 
 from __future__ import annotations
 
+import socket
 import threading
-from typing import Dict, Optional, Tuple
+import time as _time
+from typing import Dict, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -25,84 +39,225 @@ from clonos_tpu.parallel import transport as tp
 from clonos_tpu.parallel.routing import hash32_np, subtask_for_key_group
 
 
+class QueryTimeoutError(TimeoutError):
+    """A query's per-request budget (timeout x bounded retries) ran out
+    without a response — the endpoint is hung, unreachable, or
+    overloaded. Carries enough context to route around the endpoint."""
+
+    def __init__(self, address, attempts: int, budget_s: float):
+        self.address = tuple(address)
+        self.attempts = attempts
+        self.budget_s = budget_s
+        super().__init__(
+            f"query to {self.address} timed out after {attempts} "
+            f"attempt(s) within {budget_s:.3f}s")
+
+
+class QueryRejectedError(RuntimeError):
+    """The endpoint refused the read — most commonly no epoch has
+    sealed yet, so there is no fence-consistent snapshot to serve."""
+
+
+def owner_subtask_np(keys: np.ndarray, parallelism: int,
+                     num_key_groups: int
+                     ) -> Tuple[np.ndarray, np.ndarray]:
+    """Host twin of the exchange's key->owner map for a whole key batch:
+    ``(key_group, owning_subtask)`` per key. The single shared copy of
+    the assignment every read path (owner endpoint, replicas, router)
+    must agree on — byte-for-byte the device exchange's routing."""
+    kg = (hash32_np(np.asarray(keys, np.int64))
+          % num_key_groups).astype(np.int64)
+    sub = np.asarray(subtask_for_key_group(kg, parallelism,
+                                           num_key_groups), np.int64)
+    return kg, sub
+
+
+def _call_with_retry(client: tp.ControlClient, mtype: int,
+                     payload: bytes, address, timeout_s: float,
+                     retries: int, backoff_s: float):
+    """One logical request with bounded exponential backoff: each
+    attempt gets the socket timeout the client was built with; transport
+    errors retry with ``backoff_s * 2**i`` sleeps (capped count), then
+    raise :class:`QueryTimeoutError`. Application errors (ERROR frames)
+    pass straight through — only liveness failures retry."""
+    t0 = _time.monotonic()
+    attempts = 0
+    while True:
+        attempts += 1
+        try:
+            return client.call(mtype, payload)
+        except (socket.timeout, TimeoutError, OSError):
+            # ControlClient already dropped the socket; the next call
+            # reconnects. Budget check BEFORE the sleep so a dead
+            # endpoint costs at most retries * (timeout + backoff).
+            if attempts > retries:
+                raise QueryTimeoutError(
+                    address, attempts, _time.monotonic() - t0) from None
+            _time.sleep(min(backoff_s * (2 ** (attempts - 1)), 1.0))
+
+
 class QueryableStateEndpoint:
     """Serves (vertex, state_name, key) lookups over the control
-    transport."""
+    transport, point-wise or batched (one request, many keys)."""
 
     def __init__(self, runner, host: str = "127.0.0.1", port: int = 0):
         self.runner = runner
         self._lock = threading.Lock()
         self._snap: Dict[Tuple[int, str], np.ndarray] = {}
         self._epoch = -1
+        self.reads = 0
         self.refresh()
         self.server = tp.ControlServer(self._handle, host, port)
         self.address = self.server.address
 
-    def refresh(self) -> None:
-        """Main-thread fence snapshot of every vertex's array states."""
+    def refresh(self, epoch: Optional[int] = None) -> None:
+        """Main-thread fence snapshot of every vertex's array states,
+        stamped with the runner's LAST SEALED epoch — not the live
+        epoch counter, which has already moved on to the epoch being
+        computed. Before the first seal the stamp stays -1 and reads
+        are rejected (no fence to be consistent with).
+
+        ``epoch`` overrides the stamp for fence-hook callers: with the
+        PIPELINED fence the hook fires on the main thread while the
+        seal is still in flight on the fence worker, so the runner's
+        ``last_sealed_epoch`` trails the fence the snapshot actually
+        captures — the hook passes its own ``closed`` epoch instead."""
+        sealed = (int(epoch) if epoch is not None
+                  else int(getattr(self.runner, "last_sealed_epoch", -1)))
         snap: Dict[Tuple[int, str], np.ndarray] = {}
-        for v in self.runner.job.vertices:
-            st = self.runner.executor.vertex_state(v.vertex_id)
-            if not isinstance(st, dict):
-                continue
-            for name, arr in st.items():
-                snap[(v.vertex_id, name)] = np.asarray(arr)
+        if sealed >= 0:
+            for v in self.runner.job.vertices:
+                st = self.runner.executor.vertex_state(v.vertex_id)
+                if not isinstance(st, dict):
+                    continue
+                for name, arr in st.items():
+                    snap[(v.vertex_id, name)] = np.asarray(arr)
         with self._lock:
             self._snap = snap
-            self._epoch = self.runner.executor.epoch_id
+            self._epoch = sealed
 
-    def _handle(self, mtype: int, payload: bytes) -> Tuple[int, bytes]:
-        if mtype != tp.QUERY_STATE:
-            return tp.ERROR, tp.pack_json({"error": f"bad mtype {mtype}"})
-        req = tp.unpack_json(payload)
+    @property
+    def epoch(self) -> int:
+        with self._lock:
+            return self._epoch
+
+    def _resolve(self, req: dict):
+        """Shared request validation: returns (arr, epoch, parallelism)
+        or an (ERROR, payload) response tuple."""
         vid = req["vertex"]
         name = req.get("state", "acc")
-        key = req["key"]
         with self._lock:
             arr = self._snap.get((vid, name))
             epoch = self._epoch
+        if epoch < 0:
+            return tp.ERROR, tp.pack_json(
+                {"error": "no epoch sealed yet: refresh() ran before "
+                          "the first fence tail completed — reads have "
+                          "no consistency point", "rejected": True})
         if arr is None:
             return tp.ERROR, tp.pack_json(
                 {"error": f"no state ({vid}, {name})"})
-        job = self.runner.job
-        p = job.vertices[vid].parallelism
-        if arr.ndim < 2 or arr.shape[0] != p or not (
-                0 <= key < arr.shape[-1]):
+        p = self.runner.job.vertices[vid].parallelism
+        if arr.ndim < 2 or arr.shape[0] != p:
             return tp.ERROR, tp.pack_json(
                 {"error": f"state ({vid}, {name}) of shape "
-                          f"{list(arr.shape)} is not keyed or key "
-                          f"{key} out of range"})
-        # Host-side (numpy) key->owner math: a server thread must never
-        # dispatch device work (jax is main-thread-only on some
-        # backends; hash32_np is the exchange hash's host twin, and
-        # subtask_for_key_group is the SAME pure assignment the exchange
-        # compiles in).
-        kg = int(hash32_np(np.asarray(key, np.int64))
-                 % job.num_key_groups)
-        sub = int(subtask_for_key_group(kg, p, job.num_key_groups))
-        val = arr[sub, ..., key]
-        return tp.QUERY_RESPONSE, tp.pack_json(
-            {"value": np.asarray(val).tolist(), "subtask": sub,
-             "key_group": kg, "epoch": epoch})
+                          f"{list(arr.shape)} is not keyed"})
+        return arr, epoch, p
+
+    def _handle(self, mtype: int, payload: bytes) -> Tuple[int, bytes]:
+        if mtype not in (tp.QUERY_STATE, tp.QUERY_BATCH,
+                         tp.SERVE_STATUS):
+            return tp.ERROR, tp.pack_json({"error": f"bad mtype {mtype}"})
+        if mtype == tp.SERVE_STATUS:
+            with self._lock:
+                epoch = self._epoch
+            return tp.QUERY_RESPONSE, tp.pack_json(
+                {"epoch": epoch, "staleness_epochs": 0,
+                 "role": "owner", "reads": self.reads})
+        req = tp.unpack_json(payload)
+        got = self._resolve(req)
+        if len(got) == 2:
+            return got
+        arr, epoch, p = got
+        job = self.runner.job
+        if mtype == tp.QUERY_STATE:
+            key = req["key"]
+            if not 0 <= key < arr.shape[-1]:
+                return tp.ERROR, tp.pack_json(
+                    {"error": f"key {key} out of range "
+                              f"[0, {arr.shape[-1]})"})
+            # Host-side (numpy) key->owner math: a server thread must
+            # never dispatch device work (jax is main-thread-only on
+            # some backends; hash32_np is the exchange hash's host twin,
+            # and subtask_for_key_group is the SAME pure assignment the
+            # exchange compiles in).
+            kg, sub = owner_subtask_np(np.asarray(key), p,
+                                       job.num_key_groups)
+            self.reads += 1
+            val = arr[int(sub), ..., key]
+            return tp.QUERY_RESPONSE, tp.pack_json(
+                {"value": np.asarray(val).tolist(),
+                 "subtask": int(sub), "key_group": int(kg),
+                 "epoch": epoch, "staleness_epochs": 0})
+        keys = np.asarray(req["keys"], np.int64)
+        if keys.size and (keys.min() < 0 or keys.max() >= arr.shape[-1]):
+            return tp.ERROR, tp.pack_json(
+                {"error": f"key out of range [0, {arr.shape[-1]})"})
+        kg, sub = owner_subtask_np(keys, p, job.num_key_groups)
+        self.reads += int(keys.size)
+        vals = arr[sub, ..., keys]
+        return tp.QUERY_BATCH_RESPONSE, tp.pack_json(
+            {"values": np.asarray(vals).tolist(),
+             "subtasks": sub.tolist(), "key_groups": kg.tolist(),
+             "epoch": epoch, "staleness_epochs": 0})
 
     def close(self) -> None:
         self.server.close()
 
 
 class QueryableStateClient:
-    """External lookup client (QueryableStateClient analog)."""
+    """External lookup client (QueryableStateClient analog) with a
+    per-request timeout and bounded exponential backoff — a hung
+    endpoint costs a bounded wait and a typed
+    :class:`QueryTimeoutError`, never an indefinite block."""
 
-    def __init__(self, address: Tuple[str, int]):
-        self._client = tp.ControlClient(tuple(address))
+    def __init__(self, address: Tuple[int, int],
+                 timeout_s: float = 5.0, retries: int = 2,
+                 backoff_s: float = 0.05):
+        self.address = tuple(address)
+        self.timeout_s = float(timeout_s)
+        self.retries = int(retries)
+        self.backoff_s = float(backoff_s)
+        self._client = tp.ControlClient(self.address,
+                                        timeout_s=self.timeout_s)
 
-    def query(self, vertex: int, key: int,
-              state: str = "acc") -> dict:
-        rt, resp = self._client.call(tp.QUERY_STATE, tp.pack_json(
-            {"vertex": vertex, "state": state, "key": key}))
+    def _call(self, mtype: int, payload: dict) -> dict:
+        rt, resp = _call_with_retry(
+            self._client, mtype, tp.pack_json(payload), self.address,
+            self.timeout_s, self.retries, self.backoff_s)
         out = tp.unpack_json(resp)
         if rt == tp.ERROR:
+            if out.get("rejected"):
+                raise QueryRejectedError(out["error"])
             raise KeyError(out["error"])
         return out
+
+    def query(self, vertex: int, key: int, state: str = "acc") -> dict:
+        return self._call(tp.QUERY_STATE,
+                          {"vertex": vertex, "state": state, "key": key})
+
+    def query_batch(self, vertex: int, keys: Sequence[int],
+                    state: str = "acc") -> dict:
+        """Many keys in ONE request/response — the wire half of the
+        batched read path (the replica endpoint additionally fuses the
+        device reads into one gather; runtime/serve.py)."""
+        return self._call(tp.QUERY_BATCH,
+                          {"vertex": vertex, "state": state,
+                           "keys": [int(k) for k in keys]})
+
+    def status(self) -> dict:
+        """Freshness probe: ``{"epoch", "staleness_epochs", ...}``."""
+        return self._call(tp.SERVE_STATUS, {})
 
     def close(self) -> None:
         self._client.close()

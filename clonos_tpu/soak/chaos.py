@@ -18,6 +18,8 @@ Grammar (one event per line or ``;``-separated; ``#`` comments)::
                                       # device ring into the spill tiers
     at 40s nondet                     # unlogged value perturbation
                                       # (audit bait — MUST fail the run)
+    at 45s replica-kill 1             # kill serve replica 1: reads must
+                                      # re-route to the owner, no errors
 
 Durations accept ``ms``/``s`` suffixes (bare numbers are seconds).
 ``ChaosSchedule.seeded`` generates a schedule from a seed via a seeded
@@ -38,8 +40,13 @@ import numpy as np
 #: checkpoint completion so truncation stops and the replay backlog
 #: spills past the device ring into the host/disk tiers
 #: (storage/tiered.py) — the long-backlog disk-replay scenario.
+#: ``replica-kill`` targets the READ tier, not the job: a serve replica
+#: (runtime/serve.py) drops dead mid-run; the router must re-route its
+#: key groups to the owner with zero client-visible errors, and the
+#: replica revives (staleness spike, then recovery) at the next seal.
+#: Optional target = replica index (defaults to replica 0).
 FAULT_KINDS = ("kill", "gray", "leader-loss", "stall", "nondet",
-               "backlog")
+               "backlog", "replica-kill")
 
 
 def _dur(tok: str) -> float:
@@ -117,6 +124,16 @@ def _parse_event(line: str) -> ChaosEvent:
                              f"{toks[i]!r}")
         if not targets:
             raise ValueError(f"chaos event {line!r}: empty target list")
+        i += 1
+    elif kind == "replica-kill" and i < len(toks) \
+            and not toks[i].startswith(("delay=", "hold=")) \
+            and toks[i] != "for":
+        # optional replica index (defaults to replica 0 in the harness)
+        try:
+            targets = tuple(int(t) for t in toks[i].split(",") if t)
+        except ValueError:
+            raise ValueError(f"chaos event {line!r}: bad replica index "
+                             f"{toks[i]!r}")
         i += 1
     while i < len(toks):
         tok = toks[i]
@@ -273,6 +290,8 @@ class ChaosSchedule:
                 events.append(ChaosEvent(
                     float(at_s), "backlog",
                     duration_s=round(float(rng.uniform(1.0, 3.0)), 2)))
+            elif kind == "replica-kill":
+                events.append(ChaosEvent(float(at_s), "replica-kill"))
             else:                       # nondet
                 events.append(ChaosEvent(float(at_s), "nondet"))
         return cls(events)

@@ -90,6 +90,11 @@ class SoakHarness:
         #: flight — inject_failure joins the tail first, so each such
         #: kill proves the drain ordering under fire.
         self.kills_mid_fence_tail = 0
+        #: read tier under test (runtime/serve.ServeTier), attached by
+        #: the driver when a serve read load rides the run — the
+        #: ``replica-kill`` fault targets it.
+        self.serve_tier = None
+        self.replica_kills = 0
 
     # --- fault application ---------------------------------------------------
 
@@ -194,6 +199,26 @@ class SoakHarness:
     def backlog_active(self, now_s: float) -> bool:
         return now_s < self.backlog_until
 
+    def _apply_replica_kill(self, event: ChaosEvent,
+                            now_s: float) -> None:
+        # Read-tier chaos: a serve replica dies mid-run. Degradation —
+        # not failure — is the acceptance bar: the router re-routes the
+        # dead replica's key groups to the owner (a counted REROUTE,
+        # zero client-visible errors; the read load's error counter is
+        # the witness), staleness spikes, and the replica revives at the
+        # next seal from the standby pool's restore point. No audit
+        # impact: the read tier never writes job state.
+        tier = self.serve_tier
+        if tier is None:
+            self.tracer.event("soak.chaos.replica-kill.skipped",
+                              reason="no serve tier attached")
+            return
+        idx = event.targets[0] if event.targets else 0
+        tier.kill_replica(idx)
+        self.replica_kills += 1
+        self.faults_survived += 1
+        self.tracer.event("soak.chaos.replica-kill", replica=idx)
+
     def _apply_nondet(self, event: ChaosEvent, now_s: float) -> None:
         # Unlogged value perturbation on-device (audit bait): occupied
         # in-flight ring slots get salted values. Counts, keys, and
@@ -281,7 +306,8 @@ class SoakDriver:
                  schedule: Optional[ChaosSchedule] = None,
                  spec: Optional[SLOSpec] = None,
                  control=None, election=None,
-                 records_per_step: Optional[int] = None):
+                 records_per_step: Optional[int] = None,
+                 read_load=None):
         self.runner = runner
         self.cfg = config
         self.schedule = schedule if schedule is not None \
@@ -291,6 +317,12 @@ class SoakDriver:
         self.harness = SoakHarness(runner, control=control,
                                    election=election,
                                    tracer=self.tracer)
+        #: mixed-load read side (soak.serveload.ServeLoad): pumped once
+        #: per ingest chunk so reads contend with live ingestion, and
+        #: the replica-kill fault has a tier to hit.
+        self.read_load = read_load
+        if read_load is not None:
+            self.harness.serve_tier = read_load.tier
         self.slo = SLOTracker(self.spec, window_s=config.window_s,
                               tracer=self.tracer)
         self.records_per_step = records_per_step
@@ -438,10 +470,25 @@ class SoakDriver:
                              corrected_ms=(now_s - intended_s) * 1e3,
                              actual_ms=(done_wall - send_wall) * 1e3,
                              records=chunk_records)
+            # -- read load rides the same clock: each ingest chunk is
+            # chased by a burst of routed reads, so read latency and
+            # staleness are measured UNDER concurrent ingest, and a
+            # replica-kill mid-run shows up as reroutes + a staleness
+            # spike in the read windows — never as client errors.
+            if self.read_load is not None:
+                self.read_load.pump(now_s)
             # -- collected events fire mid-epoch, right after a chunk
             for ev in due:
                 h.apply(ev, now_s)
                 self.slo.observe_fault(now_s, ev.kind)
+                # A replica-kill's degradation window can close at the
+                # very next seal (revival is one fence away) — chase it
+                # with an immediate read burst so the reroutes and the
+                # staleness spike are WITNESSED while the replica is
+                # down, not inferred.
+                if (ev.kind == "replica-kill"
+                        and self.read_load is not None):
+                    self.read_load.pump(now_s)
             due.clear()
             # -- armed kills fire mid-epoch, right after a chunk
             if kill_armed and pending_kills and ex.step_in_epoch > 0:
@@ -545,6 +592,10 @@ class SoakDriver:
         r.run_epoch(complete_checkpoint=True)
         r.drain_fence()      # final sweep must see every in-flight seal
         h.audit_check()
+        if self.read_load is not None:
+            # one post-drain pump: the final fence sealed, so this burst
+            # witnesses staleness RECOVERY after any replica-kill
+            self.read_load.pump(_time.monotonic() - t0, final=True)
         wall_s = _time.monotonic() - t0
         return self._verdict(wall_s, sent_records, ei)
 
@@ -612,6 +663,12 @@ class SoakDriver:
             "schedule": self.schedule.to_text(),
             "truncated": self._truncated,
         }
+        if self.read_load is not None:
+            # Read-tier verdict rides the soak verdict: the serve
+            # numbers only mean anything against the ingest load they
+            # contended with (the honest-measurement requirement).
+            out["serve"] = self.read_load.summary()
+            out["serve"]["replica_kills"] = h.replica_kills
         # The FT call-site population this run exercised
         # (analysis/census.py): SOAK_r0N.json numbers stay traceable
         # to the exact source shape that produced them.
@@ -642,12 +699,23 @@ def next_soak_artifact_path(root: Optional[str] = None) -> str:
     return os.path.join(root, f"SOAK_r{n:02d}.json")
 
 
+def next_serve_artifact_path(root: Optional[str] = None) -> str:
+    """Next free ``SERVE_r0N.json`` slot (the ``bench --serve``
+    verdict artifact, sibling of SOAK/BENCH)."""
+    root = root or os.getcwd()
+    n = 1
+    while os.path.exists(os.path.join(root, f"SERVE_r{n:02d}.json")):
+        n += 1
+    return os.path.join(root, f"SERVE_r{n:02d}.json")
+
+
 def build_soak_fixture(workdir: str, rate: float, duration_s: float,
                        steps_per_epoch: int = 64, par: int = 2,
                        batch: int = 8, seed: int = 11,
                        audit: bool = True, lease_ttl_s: float = 2.0,
                        num_keys: int = 101,
-                       overlap_epoch: bool = False):
+                       overlap_epoch: bool = False,
+                       serve_vertex: bool = False):
     """Construct the soak trio: runner, fault-free control twin, and a
     held leader lease — same job, same seed, logical time on BOTH
     runners (digest chains are only byte-comparable across runs when
@@ -665,12 +733,16 @@ def build_soak_fixture(workdir: str, rate: float, duration_s: float,
 
     def build():
         env = StreamEnvironment(name="soak", num_key_groups=16)
-        (env.synthetic_source(vocab=num_keys, batch_size=batch,
-                              parallelism=par)
-            .key_by()
-            .window_count(num_keys=num_keys, window_size=1 << 30,
-                          name="window")
-            .sink())
+        s = (env.synthetic_source(vocab=num_keys, batch_size=batch,
+                                  parallelism=par)
+             .key_by()
+             .window_count(num_keys=num_keys, window_size=1 << 30,
+                           name="window"))
+        if serve_vertex:
+            # a KeyedReduceOperator stage (emits_running_value) so the
+            # read tier's replicas can tail it to fence freshness
+            s = s.key_by().reduce(num_keys=num_keys, name="reduce")
+        s.sink()
         return env.build()
 
     records_per_step = par * batch

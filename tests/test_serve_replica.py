@@ -1,0 +1,239 @@
+"""Read-path scale-out (runtime/serve.py): replicas tail sealed-epoch
+deltas, serve fence-consistent batched reads, and degrade — never
+error — under replica loss.
+
+The bit-identity contract is asserted the same way the exactly-once
+audit asserts its own: fold the served values into real
+:class:`EpochDigest` ledger entries per epoch on both the owner path
+and the replica path, then require ``diff_ledgers`` to find nothing.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from clonos_tpu.api.environment import StreamEnvironment
+from clonos_tpu.obs.digest import EpochDigest, diff_ledgers
+from clonos_tpu.runtime.cluster import ClusterRunner
+from clonos_tpu.runtime.query import QueryRejectedError
+from clonos_tpu.runtime.serve import build_serve_tier
+
+VID = 1          # the reduce vertex in the fixture below
+NUM_KEYS = 11
+
+
+def make_runner(seed=3, max_epochs=8):
+    env = StreamEnvironment(name="serve", num_key_groups=16,
+                            default_edge_capacity=64)
+    (env.synthetic_source(vocab=NUM_KEYS, batch_size=8, parallelism=2)
+        .key_by().reduce(num_keys=NUM_KEYS, name="r").sink())
+    return ClusterRunner(env.build(), steps_per_epoch=4,
+                         log_capacity=256, max_epochs=max_epochs,
+                         inflight_ring_steps=16, seed=seed)
+
+
+def served_entry(epoch, values):
+    """Ledger entry from one epoch's served values — diff_ledgers-style
+    comparison material."""
+    d = EpochDigest(int(epoch))
+    d.fold("acc", np.asarray(values, np.int64).tobytes(), len(values))
+    return d.to_entry()
+
+
+def test_replica_serves_bit_identical_fence_state():
+    """A replica tailing sealed-epoch deltas serves, at every fence,
+    byte-for-byte the state the owner serves at the same epoch stamp —
+    including across epochs whose checkpoint never completes (the
+    delta path, not the restore path, carries freshness)."""
+    r = make_runner()
+    tier = build_serve_tier(r, VID, n_replicas=1)
+    try:
+        rep_c = tier.clients[0]
+        keys = list(range(NUM_KEYS))
+        owner_led, replica_led = [], []
+        epochs = []
+        for e in range(4):
+            # Odd epochs leave the checkpoint pending: only the sealed
+            # delta can keep the replica fresh there.
+            r.run_epoch(complete_checkpoint=(e % 2 == 0))
+            r.drain_fence()
+            ro = tier.owner_client.query_batch(VID, keys)
+            rr = rep_c.query_batch(VID, keys)
+            assert rr["epoch"] == ro["epoch"], \
+                "replica and owner must stamp the same fence"
+            assert rr["staleness_epochs"] == 0
+            assert rr["served_by"] == "replica-0"
+            assert rr["subtasks"] == ro["subtasks"], \
+                "one key-group assignment across every read path"
+            epochs.append(rr["epoch"])
+            owner_led.append(served_entry(ro["epoch"], ro["values"]))
+            replica_led.append(served_entry(rr["epoch"], rr["values"]))
+        assert epochs == sorted(set(epochs)), "fences advance, never tear"
+        assert diff_ledgers(owner_led, replica_led) == []
+        # Point reads go through the same fused gather: same values.
+        for k in (0, 5, NUM_KEYS - 1):
+            out = rep_c.query(VID, k)
+            assert out["value"] == rr["values"][k]
+            assert out["epoch"] == rr["epoch"]
+        rep = tier.replicas[0]
+        assert rep.tailable
+        assert rep.applied_epochs >= 2, "odd epochs arrived via deltas"
+    finally:
+        tier.close()
+
+
+def test_reads_rejected_before_first_seal():
+    """No fence, no consistency point: both the owner endpoint and the
+    replica endpoint refuse reads (typed rejection, routable) until the
+    first epoch seals — then serve."""
+    r = make_runner()
+    tier = build_serve_tier(r, VID, n_replicas=1)
+    try:
+        with pytest.raises(QueryRejectedError):
+            tier.owner_client.query(VID, 0)
+        with pytest.raises(QueryRejectedError):
+            tier.clients[0].query(VID, 0)
+        r.run_epoch(complete_checkpoint=True)
+        r.drain_fence()
+        assert tier.owner_client.query(VID, 0)["epoch"] >= 0
+        assert tier.clients[0].query(VID, 0)["epoch"] >= 0
+        # Application errors are NOT rejections: out-of-range key is a
+        # KeyError on both paths (the router must not reroute those).
+        with pytest.raises(KeyError):
+            tier.owner_client.query(VID, NUM_KEYS + 500)
+        with pytest.raises(KeyError):
+            tier.clients[0].query(VID, NUM_KEYS + 500)
+    finally:
+        tier.close()
+
+
+def test_replica_kill_reroutes_then_revives():
+    """The acceptance chaos cycle, in miniature: kill a replica mid-run
+    and every read still answers (rerouted to the owner, counted);
+    staleness spikes while dead; the next fence revives the replica
+    from the standby pool and staleness recovers to zero."""
+    r = make_runner()
+    tier = build_serve_tier(r, VID, n_replicas=2, staleness_bound=2)
+    try:
+        for _ in range(2):
+            r.run_epoch(complete_checkpoint=True)
+            r.drain_fence()
+        router = tier.router
+        # a key whose group routes to replica 0
+        k0 = next(k for k in range(NUM_KEYS)
+                  if router.replica_for_group(router.key_group(k)) == 0)
+        assert router.query(VID, k0)["served_by"] == "replica-0"
+        owner_vals = tier.owner_client.query_batch(
+            VID, list(range(NUM_KEYS)))["values"]
+
+        tier.kill_replica(0)
+        assert tier.staleness()[0] >= 1, "dead replica is behind every seal"
+        time.sleep(0.06)            # let the router's status cache expire
+        reroutes0 = router.reroutes
+        out = router.query(VID, k0)  # no exception: degradation, not error
+        assert out.get("served_by", "owner") == "owner"
+        assert out["value"] == owner_vals[k0]
+        assert router.reroutes > reroutes0
+        batch = router.query_batch(VID, list(range(NUM_KEYS)))
+        assert batch["values"] == owner_vals
+
+        r.run_epoch(complete_checkpoint=True)   # next fence: revival
+        r.drain_fence()
+        rep = tier.replicas[0]
+        assert rep.alive and rep.revivals == 1
+        assert tier.staleness()[0] == 0, "staleness recovered"
+        time.sleep(0.06)
+        assert router.query(VID, k0)["served_by"] == "replica-0"
+    finally:
+        tier.close()
+
+
+def test_endpoint_coalesces_reads_into_single_dispatches():
+    """The batching win's mechanism: a wire batch of N keys costs ONE
+    device dispatch, and concurrent point lookups coalesce (dispatches
+    strictly fewer than requests under contention is not asserted —
+    only the invariant that they never exceed them)."""
+    r = make_runner()
+    tier = build_serve_tier(r, VID, n_replicas=1)
+    try:
+        r.run_epoch(complete_checkpoint=True)
+        r.drain_fence()
+        ep = tier.endpoints[0]
+        rep_c = tier.clients[0]
+        rep_c.query(VID, 0)                       # warm the gather
+        d0, k0 = ep.dispatches, ep.keys_served
+        keys = [k % NUM_KEYS for k in range(100)]
+        out = rep_c.query_batch(VID, keys)
+        assert ep.dispatches == d0 + 1, "one fused gather for the batch"
+        assert ep.keys_served == k0 + len(keys)
+        acc = np.asarray(r.executor.vertex_state(VID)["acc"])
+        for k, v, s in zip(keys, out["values"], out["subtasks"]):
+            assert v == int(acc[s, k])
+        # Concurrency smoke: parallel point readers — ONE connection
+        # each, like real clients (a single client socket is not a
+        # concurrency primitive) — all answer correctly and never
+        # out-dispatch their request count.
+        from clonos_tpu.runtime.serve import ReplicaStateClient
+        d1 = ep.dispatches
+        results = {}
+
+        def read(k):
+            c = ReplicaStateClient(ep.address)
+            try:
+                results[k] = c.query(VID, k)["value"]
+            finally:
+                c.close()
+
+        threads = [threading.Thread(target=read, args=(k,))
+                   for k in range(NUM_KEYS)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert results == {k: int(acc[:, k].sum())
+                           for k in range(NUM_KEYS)}
+        assert ep.dispatches - d1 <= NUM_KEYS
+    finally:
+        tier.close()
+
+
+def test_serve_window_lint_rule():
+    """Satellite: the overlap-window lint family covers the batched
+    read path — a blocking host sync inside the serve window is flagged,
+    the production dispatch region is clean."""
+    from clonos_tpu.lint.core import FileContext
+    from clonos_tpu.lint.overlapwindow import ServeWindowSyncRule
+
+    rule = ServeWindowSyncRule()
+    bad = (
+        "import numpy as np\n"
+        "def dispatch(fn, acc, keys):\n"
+        "    # clonos: serve-window-begin\n"
+        "    vals, subs, kgs = fn(acc, keys)\n"
+        "    host = np.asarray(vals)\n"
+        "    ready = vals.block_until_ready()\n"
+        "    # clonos: serve-window-end\n"
+        "    return host, ready\n"
+    )
+    found = rule.check(FileContext("fake.py", bad))
+    assert sorted(f.line for f in found) == [5, 6]
+    assert all(f.rule == "serve-window" for f in found)
+
+    ok = bad.replace("    host = np.asarray(vals)\n", "") \
+            .replace("    ready = vals.block_until_ready()\n",
+                     "    ready = vals\n") \
+            .replace("return host, ready", "return np.asarray(ready)")
+    assert rule.check(FileContext("fake.py", ok)) == []
+
+    torn = bad.replace("    # clonos: serve-window-end\n", "")
+    msgs = [f.message for f in rule.check(FileContext("fake.py", torn))]
+    assert any("unbalanced" in m for m in msgs)
+
+    # The production dispatch region must carry the markers AND pass.
+    import clonos_tpu.runtime.serve as serve_mod
+    path = serve_mod.__file__
+    src = open(path).read()
+    assert "clonos: serve-window-begin" in src
+    assert rule.check(FileContext(path, src)) == []
