@@ -821,6 +821,139 @@ def serve_probe(duration_s: float = 20.0):
     }
 
 
+def rescale_probe(duration_s: float = 12.0):
+    """Elastic-repartition probe (``bench.py --rescale [SECONDS]``):
+    prices a live 2->4 re-cut at a checkpoint fence, one JSON line.
+
+    Throughput is sampled before and after the re-cut under the same
+    epoch cadence, and the handoff itself is timed — the fence stall a
+    paced client would see: drain + keyed-state migration + the
+    new-shape restore point (the new incarnation's first-epoch compile
+    is reported separately; it overlaps the stall only on a multi-core
+    host). On a 1-core CI host doubling the keyed cut cannot raise
+    throughput, so the honest acceptance bar is the exactly-once
+    evidence, not a throughput win: the protocol transitions observed
+    in fence -> drain -> migrate -> redirect order, every in-flight
+    record drained and re-routed, the fenced-off incarnation refusing
+    to run, and the post-re-cut ledger diffing EMPTY against a
+    never-rescaled control via the key-group directory
+    (obs/audit.diff_ledgers_cross) while the exact byte diff refuses —
+    proof the mapped cross-layout path engaged, not a trivial pass."""
+    import tempfile
+
+    from clonos_tpu.causal import recovery as rec
+    from clonos_tpu.obs import audit as audit_mod
+    from clonos_tpu.obs.digest import diff_ledgers
+    from clonos_tpu.soak import build_soak_fixture
+
+    SPE = int(os.environ.get("BENCH_RESCALE_SPE", 32))
+    EPOCHS = int(os.environ.get("BENCH_RESCALE_EPOCHS", 4))
+    TARGET = int(os.environ.get("BENCH_RESCALE_TARGET", 4))
+    PAR, BATCH = 2, 8                     # build_soak_fixture defaults
+    per_epoch = SPE * PAR * BATCH
+    with tempfile.TemporaryDirectory() as td:
+        runner, control, _election = build_soak_fixture(
+            td, rate=2000.0, duration_s=duration_s,
+            steps_per_epoch=SPE, par=PAR, batch=BATCH, seed=11)
+        # warm both epoch programs off the measured clock
+        runner.run_epoch(complete_checkpoint=True)
+        control.run_epoch(complete_checkpoint=True)
+        runner.drain_fence()
+
+        t0 = time.monotonic()
+        for _ in range(EPOCHS):
+            runner.run_epoch(complete_checkpoint=True)
+        runner.drain_fence()
+        before_s = time.monotonic() - t0
+
+        # the live re-cut: everything between the old incarnation's
+        # last fence and the new one being runnable is fence stall
+        t0 = time.monotonic()
+        new_runner, stats = runner._soak_rescaler(TARGET)
+        stall_s = time.monotonic() - t0
+
+        t0 = time.monotonic()
+        new_runner.run_epoch(complete_checkpoint=True)
+        new_runner.drain_fence()
+        first_epoch_s = time.monotonic() - t0   # compile-dominated
+
+        t0 = time.monotonic()
+        for _ in range(EPOCHS):
+            new_runner.run_epoch(complete_checkpoint=True)
+        new_runner.drain_fence()
+        after_s = time.monotonic() - t0
+
+        # the fenced-off incarnation must refuse to double-apply
+        stale_fenced = False
+        try:
+            runner.run_epoch()
+        except rec.RecoveryError:
+            stale_fenced = True
+
+        # never-rescaled control reaches the same sealed epoch; the
+        # cross-layout diff must be clean AND the exact diff must not
+        # be (same mapping `clonos_tpu audit A --diff B` uses)
+        while control.auditor.last_epoch < new_runner.auditor.last_epoch:
+            control.run_epoch(complete_checkpoint=True)
+        control.drain_fence()
+        hi = new_runner.auditor.last_epoch
+        expected = [e for e in control.auditor.ledger()
+                    if e["epoch"] <= hi]
+        actual = [e for e in new_runner.auditor.ledger()
+                  if e["epoch"] <= hi]
+        cross = audit_mod.diff_ledgers_cross(expected, actual)
+        exact = diff_ledgers(expected, actual)
+
+    kinds = [k for k, _ in stats["transitions"]]
+    first = {k: kinds.index(k) for k in dict.fromkeys(kinds)}
+    proto_ok = ("fence" in first and "migrate" in first
+                and kinds[-1] == "redirect"
+                and first["fence"] < first["migrate"]
+                and kinds.count("migrate") == stats["groups"]
+                and ("drain" not in first
+                     or first["drain"] > first["fence"]))
+    moved = stats["moved_key_groups"]
+    stall_ms = stall_s * 1e3
+    passed = bool(cross == [] and exact and stale_fenced and proto_ok
+                  and moved and all(m > 0 for m in moved.values())
+                  and hi > stats["fence_checkpoint"])
+    out = {
+        "metric": "rescale_live_recut",
+        "value": round(stall_ms, 1),
+        "unit": f"ms fence stall for a live {PAR}->{TARGET} keyed "
+                f"re-cut (drain + migrate + new-shape restore point)",
+        "pass": passed,
+        "target_parallelism": TARGET,
+        "steps_per_epoch": SPE,
+        "epochs_each_side": EPOCHS,
+        "throughput_before": round(EPOCHS * per_epoch / before_s, 1),
+        "throughput_after": round(EPOCHS * per_epoch / after_s, 1),
+        "fence_stall_ms": round(stall_ms, 1),
+        "migrate_ms": round(stats["migrate_ms"], 1),
+        "post_recut_first_epoch_ms": round(first_epoch_s * 1e3, 1),
+        "drained_records": stats["drained_records"],
+        "moved_key_groups": moved,
+        "protocol_groups": stats["groups"],
+        "transitions": kinds,
+        "protocol_order_ok": proto_ok,
+        "stale_writer_fenced": stale_fenced,
+        "cross_ledger_diff_clean": cross == [],
+        "cross_ledger_diff": cross[:4],
+        "exact_diff_refuses": bool(exact),
+        "exact_diff_lines": len(exact),
+        "epochs_checked": len(actual),
+        "note": "single-host CI shape: throughput_before/after share "
+                "one core, so the re-cut prices the protocol (stall + "
+                "exactly-once evidence), not a scaling win",
+    }
+    try:
+        from clonos_tpu.analysis import census_fingerprint
+        out["census_fingerprint"] = census_fingerprint()
+    except Exception:                                 # pragma: no cover
+        out["census_fingerprint"] = None
+    return out
+
+
 def spill_probe():
     """Tiered-storage probe (``bench.py --spill``): prices the spill
     fabric (clonos_tpu/storage/) three ways, one JSON line.
@@ -978,7 +1111,19 @@ def spill_probe():
 
 
 def main(jobs=None, multichip=None, soak=None, ablate=False,
-         spill=False, serve=None):
+         spill=False, serve=None, rescale=None):
+    if rescale:
+        # --rescale [SECONDS]: run ONLY the elastic-repartition probe
+        # (one JSON line, same contract as the headline bench) and
+        # persist it as the next free RESCALE_r0N.json artifact.
+        from clonos_tpu.soak import next_rescale_artifact_path
+        out = rescale_probe(float(rescale))
+        path = next_rescale_artifact_path()
+        with open(path, "w") as f:
+            json.dump(out, f, indent=1)
+        out["artifact"] = os.path.basename(path)
+        print(json.dumps(out))
+        return 0 if out["pass"] else 1
     if serve:
         # --serve [SECONDS]: run ONLY the read-path probe (one JSON
         # line, same contract as the headline bench) and persist it as
@@ -1448,6 +1593,15 @@ if __name__ == "__main__":
                          "bit-identity vs the owner, mixed read/ingest "
                          "load with a replica-kill) instead of the "
                          "headline bench; writes SERVE_r0N.json")
+    ap.add_argument("--rescale", type=float, nargs="?", const=12.0,
+                    default=None, metavar="SECONDS",
+                    help="run the elastic-repartition probe (live 2->4 "
+                         "re-cut at a checkpoint fence under load: "
+                         "throughput before/after, fence-stall cost, "
+                         "cross-layout ledger diff vs a never-rescaled "
+                         "control) instead of the headline bench; "
+                         "writes RESCALE_r0N.json")
     _a = ap.parse_args()
     sys.exit(main(jobs=_a.jobs, multichip=_a.multichip, soak=_a.soak,
-                  ablate=_a.ablate, spill=_a.spill, serve=_a.serve))
+                  ablate=_a.ablate, spill=_a.spill, serve=_a.serve,
+                  rescale=_a.rescale))

@@ -149,7 +149,8 @@ def cmd_bench(args) -> int:
                     multichip=getattr(args, "multichip", None),
                     soak=getattr(args, "soak", None),
                     ablate=getattr(args, "ablate", False),
-                    serve=getattr(args, "serve", None))
+                    serve=getattr(args, "serve", None),
+                    rescale=getattr(args, "rescale", None))
     return int(rc or 0)
 
 
@@ -417,7 +418,7 @@ def cmd_audit(args) -> int:
     against a single-job run's), and a diff over an ambiguous
     multi-job root exits 2 listing the available job ids."""
     import os
-    from clonos_tpu.obs import digest as _digest
+    from clonos_tpu.obs import audit as _audit_mod
 
     ledgers = _find_ledgers(args.dir)
     if not ledgers:
@@ -460,7 +461,11 @@ def cmd_audit(args) -> int:
         problems = []
         groups = {}
         for label, entries in ledgers:
-            lines = _digest.diff_ledgers(entries, other.get(label, []))
+            # Layout-aware: epochs sealed under the same cut compare
+            # bit for bit; across a live re-cut the group-directory
+            # mapping compares the partition-invariant channels.
+            lines = _audit_mod.diff_ledgers_cross(entries,
+                                                  other.get(label, []))
             groups[label] = {"entries": len(entries),
                              "epochs": len({e.get("epoch")
                                             for e in entries}),
@@ -1098,6 +1103,14 @@ def main(argv=None) -> int:
                          "bit-identity vs the owner, and mixed "
                          "read/ingest load with a replica-kill "
                          "(writes SERVE_r0N.json)")
+    pb.add_argument("--rescale", type=float, nargs="?", const=12.0,
+                    default=None, metavar="SECONDS",
+                    help="run ONLY the elastic-repartition probe: a "
+                         "live 2->4 keyed re-cut at a checkpoint fence "
+                         "under load — throughput before/after, fence-"
+                         "stall cost, exactly-once handoff evidence, "
+                         "cross-layout ledger diff vs a never-rescaled "
+                         "control (writes RESCALE_r0N.json)")
     pb.add_argument("--ablate", action="store_true",
                     help="run ONLY the no-FT ablation probe: the "
                          "semantics-preserving twin head-to-head "
@@ -1280,7 +1293,9 @@ def main(argv=None) -> int:
     pa.add_argument("--diff", default=None, metavar="DIR",
                     help="second run's checkpoint dir; exit 1 naming "
                          "the first diverging epoch and channel per "
-                         "group")
+                         "group (layout-aware: epochs sealed under "
+                         "different cuts of one job compare via the "
+                         "key-group directory)")
     pa.add_argument("--job", default=None, metavar="ID",
                     help="select one job's ledgers under a dispatcher "
                          "root (<dir>/<job-id>/g*/ledger.jsonl); "
@@ -1425,12 +1440,13 @@ def main(argv=None) -> int:
     pv = sub.add_parser("verify",
                         help="protocol model checker: exhaustive "
                              "exploration of the checkpoint/recovery/"
-                             "lease/admission protocols with chaos-"
-                             "replayable counterexamples")
+                             "lease/admission/repartition protocols "
+                             "with chaos-replayable counterexamples")
     pv.add_argument("--model", action="append", default=[],
                     metavar="NAME",
                     help="model to check: checkpoint, recovery, lease, "
-                         "admission (repeatable; default: all four)")
+                         "admission, repartition (repeatable; "
+                         "default: all five)")
     pv.add_argument("--workers", type=int, default=2,
                     help="worker/contender count in the bound "
                          "(default 2)")

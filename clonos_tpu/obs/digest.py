@@ -50,17 +50,28 @@ def chain(state: bytes, data: bytes) -> bytes:
 class EpochDigest:
     """Digest of one epoch: per-channel (count, chain fingerprint) plus
     determinant counts per tag name. Mutable while folding; sealed form
-    is the JSON-able dict from :meth:`to_entry`."""
+    is the JSON-able dict from :meth:`to_entry`.
 
-    __slots__ = ("epoch", "channels", "det_counts")
+    ``layout`` optionally stamps the partition shape the digest was
+    sealed under — ``((vertex_id, parallelism), ...)`` — so a ledger
+    diff can tell "same job, different cut" from "different content"
+    and fall back to the layout-invariant channels
+    (obs/audit.py ``diff_ledgers_cross``). It is metadata, not
+    content: equality, :func:`diff` and :meth:`combined` all ignore
+    it."""
+
+    __slots__ = ("epoch", "channels", "det_counts", "layout")
 
     def __init__(self, epoch: int,
                  channels: Optional[Dict[str, Tuple[int, bytes]]] = None,
-                 det_counts: Optional[Dict[str, int]] = None):
+                 det_counts: Optional[Dict[str, int]] = None,
+                 layout: Optional[Tuple[Tuple[int, int], ...]] = None):
         self.epoch = int(epoch)
         #: channel name -> (records folded, current chain state)
         self.channels: Dict[str, Tuple[int, bytes]] = dict(channels or {})
         self.det_counts: Dict[str, int] = dict(det_counts or {})
+        self.layout = (tuple((int(v), int(p)) for v, p in layout)
+                       if layout else None)
 
     # --- folding -------------------------------------------------------------
 
@@ -103,7 +114,8 @@ class EpochDigest:
             raise ValueError(
                 f"cannot merge digests sharing channels {sorted(overlap)}: "
                 f"a channel's chain is ordered and owned by one folder")
-        out = EpochDigest(self.epoch, self.channels, self.det_counts)
+        out = EpochDigest(self.epoch, self.channels, self.det_counts,
+                          layout=self.layout or other.layout)
         out.channels.update(other.channels)
         for tag, n in other.det_counts.items():
             out.det_counts[tag] = out.det_counts.get(tag, 0) + n
@@ -112,8 +124,10 @@ class EpochDigest:
     # --- serialization -------------------------------------------------------
 
     def to_entry(self) -> dict:
-        """Ledger-entry form (plain JSON-able dict)."""
-        return {
+        """Ledger-entry form (plain JSON-able dict). ``layout`` is
+        emitted only when stamped, so unstamped entries keep the exact
+        pre-layout byte format."""
+        out = {
             "epoch": self.epoch,
             "combined": self.combined(),
             "records": self.record_count(),
@@ -122,6 +136,9 @@ class EpochDigest:
                          in sorted(self.channels.items())},
             "det_counts": dict(sorted(self.det_counts.items())),
         }
+        if self.layout is not None:
+            out["layout"] = [[v, p] for v, p in self.layout]
+        return out
 
     @classmethod
     def from_entry(cls, entry: dict) -> "EpochDigest":
@@ -129,7 +146,8 @@ class EpochDigest:
                  for name, c in (entry.get("channels") or {}).items()}
         return cls(int(entry["epoch"]), chans,
                    {k: int(v)
-                    for k, v in (entry.get("det_counts") or {}).items()})
+                    for k, v in (entry.get("det_counts") or {}).items()},
+                   layout=entry.get("layout"))
 
     def __eq__(self, other) -> bool:
         return (isinstance(other, EpochDigest)

@@ -54,7 +54,8 @@ from clonos_tpu.runtime import checkpoint as cp
 from clonos_tpu.obs import get_tracer
 from clonos_tpu.storage import SegmentCorruptError, StorageError
 from clonos_tpu.runtime.executor import (DETS_PER_STEP, JobCarry,
-                                         LeanSnapshot, LocalExecutor)
+                                         LeanSnapshot, LocalExecutor,
+                                         LogicalTimeSource)
 
 
 class HeartbeatMonitor:
@@ -386,6 +387,11 @@ class ClusterRunner:
         # the default is the zero-overhead NullAuditor — no digest reads,
         # no ledger writes, no wire fields.
         from clonos_tpu.obs import audit as _audit_mod
+        #: partition shape stamped into every sealed digest so ledger
+        #: diffs across a live re-cut know which epochs need the
+        #: layout-invariant comparison (obs/audit.diff_ledgers_cross).
+        self._audit_layout = tuple(
+            (v.vertex_id, v.parallelism) for v in job.vertices)
         if audit is None:
             audit = _audit_mod.get_auditor().enabled
         if audit:
@@ -1341,7 +1347,208 @@ class ClusterRunner:
             op_states=tuple(ops), edge_bufs=tuple(bufs))
         return runner
 
-    def attach_file_sink(self, vertex_id: int, root: str, election=None):
+    def rescale_live(self, job_new: JobGraph,
+                     observers: Sequence = (),
+                     feed_readers: Optional[Dict[int, object]] = None,
+                     **runner_kw
+                     ) -> Tuple["ClusterRunner", Dict[str, Any]]:
+        """Elastic re-cut under live traffic: at THIS runner's completed
+        checkpoint fence, stand up a new incarnation of the job at a
+        different keyed parallelism and hand off exactly once — no
+        record lost, none duplicated. The verified protocol
+        (verify/models.RepartitionModel) is fence → drain → migrate →
+        redirect, driven through a
+        :class:`~clonos_tpu.runtime.scheduler.RescaleCoordinator` whose
+        ``transition_observers`` conformance hooks fire at every step.
+
+        fence    — the latest COMPLETED checkpoint is the handoff point
+                   (the caller just ran ``run_epoch``, so the fence
+                   seals every epoch up to ``epoch_id - 1``; the ledger
+                   certifies them).
+        drain    — the old lanes' in-flight edge buffers were captured
+                   IN that checkpoint; counting them into the migration
+                   payload is the drain (nothing is dropped on the
+                   floor: route_hash_block re-cuts them below).
+        migrate  — keyed state splits/merges by key-group ownership and
+                   the drained buffers re-route at the new parallelism
+                   (``restore_rescaled``); the old↔new group directory
+                   comes from the audit layer
+                   (obs/audit.key_group_directory) — the same mapping
+                   ``audit A --diff B`` uses, built once and reused.
+        redirect — the new incarnation adopts the epoch cursor, ledger
+                   and RNG stream mid-run (the ``bootstrap_standby``
+                   zero-replay surgery) and the OLD incarnation is
+                   fenced off: its subtasks are marked failed so a
+                   stale ``run_epoch``/``step`` raises instead of
+                   double-applying records.
+
+        Returns ``(new_runner, stats)``; the caller rebinds its handle
+        (and re-homes any read tier: ``ServeTier.rehome``). ``stats``
+        reports the fence checkpoint, drained record count, moved key
+        groups per rescaled vertex, and the observed protocol
+        transitions."""
+        from clonos_tpu.obs import audit as _audit_mod
+        from clonos_tpu.runtime.scheduler import RescaleCoordinator
+        if self.failed:
+            raise rec.RecoveryError(
+                f"rescale_live: failed subtasks {sorted(self.failed)} — "
+                f"recover() first; a re-cut needs a healthy fence")
+        self.drain_fence()
+        if self.executor.step_in_epoch != 0:
+            raise rec.RecoveryError(
+                f"rescale_live: mid-epoch (step {self.executor.step_in_epoch}"
+                f"/{self.executor.steps_per_epoch}) — a re-cut happens at "
+                f"an epoch fence; finish the epoch first")
+        ids = self.coordinator.storage.completed_ids()
+        if not ids:
+            raise rec.RecoveryError(
+                "rescale_live: no completed checkpoint — the fence the "
+                "re-cut hands off at does not exist yet")
+        ckpt = self.coordinator.storage.read(max(ids))
+        if ckpt.checkpoint_id != self.executor.epoch_id - 1:
+            raise rec.RecoveryError(
+                f"rescale_live: latest completed checkpoint "
+                f"{ckpt.checkpoint_id} is not the current fence "
+                f"(epoch {self.executor.epoch_id - 1}) — run the epoch "
+                f"to completion (complete_checkpoint=True) first")
+        tr = get_tracer()
+        job_old = self.job
+
+        # The re-cut's control plane: one group per OLD lane of each
+        # rescaled vertex. Guards on the coordinator refuse exactly the
+        # orderings the model's seeded bugs inject.
+        rescaled = [(v_new, v_old)
+                    for v_new, v_old in zip(job_new.vertices,
+                                            job_old.vertices)
+                    if v_new.parallelism != v_old.parallelism]
+        lanes: List[Tuple[int, int]] = []   # (vertex_id, old lane)
+        for v_new, v_old in rescaled:
+            lanes += [(v_old.vertex_id, s)
+                      for s in range(v_old.parallelism)]
+        coord = RescaleCoordinator(len(lanes))
+        events: List[tuple] = []
+        coord.transition_observers.append(
+            lambda kind, **f: events.append((kind, tuple(sorted(f.items())))))
+        coord.transition_observers.extend(observers)
+
+        # Per-old-lane in-flight counts: the depth-1 edge buffers the
+        # fence checkpoint captured (the records "in the pipe" at the
+        # handoff point).
+        inflight = [0] * len(lanes)
+        for g, (vid, lane) in enumerate(lanes):
+            for eidx in job_old.in_edges(vid):
+                buf = ckpt.carry.edge_bufs[eidx]
+                inflight[g] += int(np.asarray(buf.valid)[lane].sum())
+            if inflight[g]:
+                coord.note_inflight(g, inflight[g])
+        coord.fence(ckpt.checkpoint_id)
+
+        # Migration: keyed-state surgery + edge-buffer re-route at the
+        # new parallelism, from the SAME fence checkpoint.
+        t_mig = _time.monotonic()
+        runner = type(self).restore_rescaled(job_new, job_old, ckpt,
+                                             **runner_kw)
+        for vid, reader in (feed_readers or {}).items():
+            runner.executor.register_feed(vid, reader)
+        directories = {
+            v_old.vertex_id: _audit_mod.key_group_directory(
+                v_old.parallelism, v_new.parallelism,
+                job_new.num_key_groups)
+            for v_new, v_old in rescaled}
+        for g, (vid, lane) in enumerate(lanes):
+            if inflight[g]:
+                coord.drain(g, inflight[g])
+            coord.migrate(g)
+        migrate_ms = (_time.monotonic() - t_mig) * 1e3
+
+        # Epoch-continuity surgery (bootstrap_standby's zero-replay
+        # recipe): the new incarnation resumes at the fence — same
+        # epoch cursor, same global step, same host-RNG position — so
+        # its next sealed epoch continues the adopted ledger.
+        spe = runner.executor.steps_per_epoch
+        from_epoch = ckpt.checkpoint_id + 1
+        if ckpt.carry.ring_heads:
+            fence = int(np.asarray(ckpt.carry.ring_heads[0]))
+        else:
+            fence = from_epoch * spe
+        runner.global_step = fence
+        runner.executor._steps_executed = fence
+        runner.executor.step_input_history = [(0, 0)] * fence
+        if runner.latency is not None:
+            runner.latency._seen = fence
+        runner.executor.epoch_id = from_epoch
+        runner.executor.step_in_epoch = 0
+        runner._fence_step[from_epoch] = fence
+        runner._ring_tail_mirror = fence
+        runner._ck_log_heads[ckpt.checkpoint_id] = np.asarray(
+            runner.executor.carry.logs.head).astype(np.int64)
+        c = runner.executor.carry
+        new_rings = []
+        for el in c.out_rings:
+            starts = np.asarray(el.epoch_starts).copy()
+            starts[from_epoch % starts.shape[0]] = fence
+            new_rings.append(el._replace(
+                head=jnp.asarray(fence, jnp.int32),
+                tail=jnp.asarray(fence, jnp.int32),
+                epoch_starts=jnp.asarray(starts, jnp.int32),
+                latest_epoch=jnp.asarray(from_epoch, jnp.int32),
+                epoch_base=jnp.asarray(from_epoch, jnp.int32)))
+        runner.executor.carry = c._replace(out_rings=tuple(new_rings))
+        runner.executor.fast_forward_host_rng(fence)
+        # The causal-time source is a live host object: the new
+        # incarnation keeps ticking the OLD one's stream (a fresh
+        # source would replay timestamps from zero and shift every
+        # window fire). EXCEPT logical time, which is bound to its
+        # executor's step_input_history — the new incarnation's own
+        # (history rebuilt to the fence above) already resumes at the
+        # right step, while the old one's is frozen at the fence.
+        if not isinstance(self.executor.time_source, LogicalTimeSource):
+            runner.executor.time_source = self.executor.time_source
+
+        # Ledger adoption: the new incarnation carries the pre-re-cut
+        # seals forward, so one continuous audit chain spans the
+        # re-cut — post-re-cut epochs diff against pre-re-cut ones via
+        # the group directory (diff_ledgers_cross), which is what makes
+        # "no record lost or duplicated" checkable after the fact.
+        if runner.auditor.enabled and self.auditor.enabled:
+            runner.auditor.adopt(self.auditor.ledger())
+        runner.last_sealed_epoch = max(runner.last_sealed_epoch,
+                                       self.last_sealed_epoch)
+
+        # Durable restore point in the NEW shape: re-fence the handoff
+        # checkpoint over the re-cut carry, so a failure in the first
+        # post-re-cut epoch recovers at the new parallelism instead of
+        # finding an old-shaped snapshot.
+        runner.coordinator.trigger(ckpt.checkpoint_id,
+                                   runner.executor.lean_snapshot(),
+                                   async_write=False, owned=True)
+        runner.coordinator.ack_all(ckpt.checkpoint_id)
+
+        # Redirect: every group is migrated (the coordinator verifies),
+        # traffic belongs to the new incarnation, and the old one is
+        # fenced off — a stale writer raises instead of double-applying.
+        coord.redirect()
+        self.failed = set(range(job_old.total_subtasks()))
+        for f in self.failed:
+            self.heartbeats.mark_dead(f)
+
+        stats = {
+            "fence_checkpoint": ckpt.checkpoint_id,
+            "from_epoch": from_epoch,
+            "groups": len(lanes),
+            "drained_records": int(sum(inflight)),
+            "moved_key_groups": {
+                vid: len(_audit_mod.moved_key_groups(d))
+                for vid, d in directories.items()},
+            "migrate_ms": migrate_ms,
+            "transitions": events,
+        }
+        tr.event("rescale.redirect", **{k: v for k, v in stats.items()
+                                        if k != "transitions"})
+        return runner, stats
+
+    def attach_file_sink(self, vertex_id: int, root: str, election=None,
+                         token: int = 0):
         """Back a transactional sink with durable part files
         (runtime/filesink.py — the StreamingFileSink analog): pendings
         persist at every epoch seal, commits are atomic renames, and
@@ -1353,12 +1560,18 @@ class ClusterRunner:
         (the standby-takeover deployment this sink exists for), a
         fenced-off incarnation attaching here must NOT run the startup
         sweep — it would delete the healthy writer's in-progress
-        pendings."""
+        pendings.
+
+        ``token`` is the writer's fencing token (monotone incarnation
+        number — e.g. bump it on each live re-cut); the startup sweep
+        only ever deletes parts at or below it, so a stale incarnation
+        attaching to a shared root cannot destroy a newer writer's
+        in-progress parts even without a leadership handle."""
         from clonos_tpu.runtime.filesink import FileSystemSink
         if vertex_id not in self.txn_logs:
             raise ValueError(
                 f"vertex {vertex_id} is not a transactional sink")
-        fs = FileSystemSink(root, fencing=election)
+        fs = FileSystemSink(root, fencing=election, token=token)
         tl = self.txn_logs[vertex_id]
         tl.pre_committer = fs.write_pending
         tl.committer = fs.commit
@@ -1523,7 +1736,8 @@ class ClusterRunner:
             from clonos_tpu.obs import audit as _audit_mod
             t = _time.monotonic()
             with prof.section("digest-seal"):
-                dg = _audit_mod.digest_epoch_window(closed, win)
+                dg = _audit_mod.digest_epoch_window(
+                    closed, win, layout=self._audit_layout)
                 self.auditor.seal(dg)
             phases["fence.digest-seal"] = (_time.monotonic() - t) * 1e3
             t = _time.monotonic()

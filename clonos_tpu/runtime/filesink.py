@@ -38,11 +38,22 @@ class FileSystemSink:
     unless this incarnation currently holds the lease: two incarnations
     sharing the sink root is exactly the standby-takeover scenario, and
     the deposed one sweeping on startup would delete the healthy
-    writer's in-progress pendings."""
+    writer's in-progress pendings.
 
-    def __init__(self, root: str, fencing=None):
+    ``token`` is the writer's fencing token — a monotone incarnation
+    number (leader term, re-cut generation). It is baked into every
+    part filename (``part-<epoch>-<sub>-t<token>.*``) so the
+    destructive :meth:`sweep_pending` can tell WHOSE in-progress parts
+    it is looking at: an incarnation only ever sweeps pendings of
+    tokens at or below its own — a stale sweeper (old token) can never
+    delete a newer writer's in-progress parts, even when no leadership
+    handle is wired in. Token-less legacy filenames parse as token 0.
+    """
+
+    def __init__(self, root: str, fencing=None, token: int = 0):
         self.root = root
         self.fencing = fencing
+        self.token = int(token)
         os.makedirs(root, exist_ok=True)
 
     def _check_fencing(self, what: str) -> None:
@@ -54,7 +65,20 @@ class FileSystemSink:
                 f"may be writing")
 
     def _part(self, epoch: int, sub: int, state: str) -> str:
-        return os.path.join(self.root, f"part-{epoch}-{sub}.{state}")
+        return os.path.join(
+            self.root, f"part-{epoch}-{sub}-t{self.token}.{state}")
+
+    @staticmethod
+    def _parse(fn: str) -> Tuple[int, int, int]:
+        """``(epoch, subtask, token)`` of a part filename; token-less
+        legacy names (``part-<e>-<s>.*``) read as token 0."""
+        stem = fn.split(".", 1)[0]
+        fields = stem.split("-")
+        epoch, sub = int(fields[1]), int(fields[2])
+        token = 0
+        if len(fields) > 3 and fields[3].startswith("t"):
+            token = int(fields[3][1:])
+        return epoch, sub, token
 
     # --- TransactionLog hooks ------------------------------------------------
 
@@ -73,19 +97,32 @@ class FileSystemSink:
 
     def commit(self, epoch: int, _rows: np.ndarray) -> None:
         """Checkpoint complete: pendings of ``epoch`` become final,
-        atomically, subtask-major."""
+        atomically, subtask-major. Only parts at or below this writer's
+        token — a newer incarnation's pendings are not this writer's to
+        certify."""
         self._check_fencing("commit")
         for fn in sorted(os.listdir(self.root)):
-            if fn.startswith(f"part-{epoch}-") and fn.endswith(".pending"):
-                src = os.path.join(self.root, fn)
-                os.replace(src, src[:-len(".pending")] + ".final")
+            if not (fn.startswith(f"part-{epoch}-")
+                    and fn.endswith(".pending")):
+                continue
+            if self._parse(fn)[2] > self.token:
+                continue
+            src = os.path.join(self.root, fn)
+            os.replace(src, src[:-len(".pending")] + ".final")
 
     # --- restart / observation ----------------------------------------------
 
     def sweep_pending(self, keep_epochs: Sequence[int] = ()) -> List[str]:
         """Startup recovery: delete pendings whose epoch is not in
         ``keep_epochs`` (their checkpoint will never complete — the
-        recoverAndAbort pass). Returns the removed filenames."""
+        recoverAndAbort pass). Returns the removed filenames.
+
+        Token-fenced: pendings and temp orphans above this writer's own
+        token are a NEWER incarnation's in-progress parts — sharing the
+        root during a handoff (live re-cut, standby takeover), a stale
+        sweeper must leave them alone. Strictly-older tokens are always
+        dead (their incarnation was fenced off) and sweep regardless of
+        ``keep_epochs``; same-token pendings sweep unless kept."""
         self._check_fencing("sweep_pending")
         keep = set(keep_epochs)
         removed = []
@@ -93,13 +130,16 @@ class FileSystemSink:
             if fn.endswith(".tmp"):
                 # A crash between temp write and rename leaves an orphan
                 # that would otherwise accumulate forever.
-                os.remove(os.path.join(self.root, fn))
-                removed.append(fn)
+                if self._parse(fn)[2] <= self.token:
+                    os.remove(os.path.join(self.root, fn))
+                    removed.append(fn)
                 continue
             if not fn.endswith(".pending"):
                 continue
-            epoch = int(fn.split("-")[1])
-            if epoch not in keep:
+            epoch, _sub, token = self._parse(fn)
+            if token > self.token:
+                continue
+            if token < self.token or epoch not in keep:
                 os.remove(os.path.join(self.root, fn))
                 removed.append(fn)
         return removed
@@ -108,7 +148,7 @@ class FileSystemSink:
         out = set()
         for fn in os.listdir(self.root):
             if fn.endswith(".final"):
-                out.add(int(fn.split("-")[1]))
+                out.add(self._parse(fn)[0])
         return sorted(out)
 
     def read_committed(self) -> np.ndarray:
@@ -117,9 +157,8 @@ class FileSystemSink:
         parts: List[Tuple[int, int, str]] = []
         for fn in os.listdir(self.root):
             if fn.endswith(".final"):
-                stem = fn[: -len(".final")]
-                _, e, s = stem.split("-")
-                parts.append((int(e), int(s), fn))
+                e, s, _t = self._parse(fn)
+                parts.append((e, s, fn))
         rows = [np.load(os.path.join(self.root, fn))
                 for _e, _s, fn in sorted(parts)]
         rows = [r for r in rows if r.shape[0]]

@@ -285,11 +285,46 @@ class ReadReplica:
             self._dev = None
             self._dev_epoch = -1
 
+    def rehome(self, new_runner) -> None:
+        """Live re-cut (``ClusterRunner.rescale_live``): re-attach this
+        replica to the NEW incarnation. The key-group->subtask owner map
+        changes with the vertex's parallelism, so the old view's
+        table SHAPE is wrong — drop it and re-adopt from the new
+        runner's restore point (rescale_live re-fences the handoff
+        checkpoint in the new shape, so one is always there). During
+        the window between re-home and re-adopt the replica reads as
+        dead: the router REROUTES to the owner, clients see staleness,
+        never errors."""
+        with self._lock:
+            try:
+                self.runner.serve_feeds.remove(self._on_seal)
+            except ValueError:
+                pass
+            self.runner = new_runner
+            v = new_runner.job.vertices[self.vertex_id]
+            self.parallelism = v.parallelism
+            self.num_key_groups = new_runner.job.num_key_groups
+            self.tailable = bool(getattr(v.operator,
+                                         "emits_running_value", False))
+            self.alive = True
+            self._arr = None
+            self._epoch = -1
+            self._owner_of = None
+            self._dev = None
+            self._dev_epoch = -1
+        new_runner.serve_feeds.append(self._on_seal)
+        new_runner.coordinator.subscribe_completed_state(
+            self._on_checkpoint)
+        ck = new_runner.standbys.latest
+        if ck is not None:
+            self._on_checkpoint(ck)
+
     def close(self) -> None:
-        try:
-            self.runner.serve_feeds.remove(self._on_seal)
-        except ValueError:
-            pass
+        with self._lock:
+            try:
+                self.runner.serve_feeds.remove(self._on_seal)
+            except ValueError:
+                pass
 
 
 class ReplicaServeEndpoint:
@@ -701,6 +736,30 @@ class ServeTier:
 
     def mark_reads(self, n: int) -> None:
         self._meter.mark(n)
+
+    def rehome(self, new_runner) -> None:
+        """Re-home the whole read tier after a live re-cut: the owner
+        endpoint snapshots the NEW runner, every replica re-adopts in
+        the new shape (key-group->replica assignment ``kg % R`` is
+        recomputed per read from the new parallelism), fence hooks and
+        gauges move over. Reads issued during the handoff window
+        reroute to the owner — degradation shows as staleness, never as
+        a client-visible error."""
+        try:
+            self.runner.fence_hooks.remove(self._on_fence)
+        except ValueError:
+            pass
+        self.runner = new_runner
+        self.owner_endpoint.runner = new_runner
+        self.owner_endpoint.refresh()
+        for rep in self.replicas:
+            rep.rehome(new_runner)
+        # Freshness probes cached against the old incarnation would
+        # keep routing on stale staleness for a TTL — drop them.
+        with self.router._lock:
+            self.router._status = [None] * len(self.router.replicas)
+        new_runner.fence_hooks.append(self._on_fence)
+        self._register_gauges()
 
     def kill_replica(self, i: int) -> None:
         self.replicas[i % len(self.replicas)].kill()

@@ -20,6 +20,8 @@ Grammar (one event per line or ``;``-separated; ``#`` comments)::
                                       # (audit bait — MUST fail the run)
     at 45s replica-kill 1             # kill serve replica 1: reads must
                                       # re-route to the owner, no errors
+    at 50s rescale 4                  # live re-cut: keyed vertices to
+                                      # parallelism 4 at the next fence
 
 Durations accept ``ms``/``s`` suffixes (bare numbers are seconds).
 ``ChaosSchedule.seeded`` generates a schedule from a seed via a seeded
@@ -45,8 +47,13 @@ import numpy as np
 #: key groups to the owner with zero client-visible errors, and the
 #: replica revives (staleness spike, then recovery) at the next seal.
 #: Optional target = replica index (defaults to replica 0).
+#: ``rescale`` re-cuts the JOB under live traffic: at the next
+#: completed checkpoint fence the keyed vertices re-partition to the
+#: target parallelism (``ClusterRunner.rescale_live``) — exactly-once
+#: must hold across the handoff and the read tier re-homes. Target =
+#: the new keyed parallelism (exactly one positive integer).
 FAULT_KINDS = ("kill", "gray", "leader-loss", "stall", "nondet",
-               "backlog", "replica-kill")
+               "backlog", "replica-kill", "rescale")
 
 
 def _dur(tok: str) -> float:
@@ -113,7 +120,20 @@ def _parse_event(line: str) -> ChaosEvent:
     duration_s = 0.0
     hold_s = 0.0
     i = 3
-    if kind in ("kill", "gray"):
+    if kind == "rescale":
+        if i >= len(toks):
+            raise ValueError(f"chaos event {line!r}: rescale needs the "
+                             f"new keyed parallelism")
+        try:
+            targets = (int(toks[i]),)
+        except ValueError:
+            raise ValueError(f"chaos event {line!r}: bad parallelism "
+                             f"{toks[i]!r}")
+        if targets[0] < 1:
+            raise ValueError(f"chaos event {line!r}: parallelism must "
+                             f"be positive")
+        i += 1
+    elif kind in ("kill", "gray"):
         if i >= len(toks):
             raise ValueError(f"chaos event {line!r}: {kind} needs "
                              f"target subtask(s)")
@@ -292,6 +312,12 @@ class ChaosSchedule:
                     duration_s=round(float(rng.uniform(1.0, 3.0)), 2)))
             elif kind == "replica-kill":
                 events.append(ChaosEvent(float(at_s), "replica-kill"))
+            elif kind == "rescale":
+                # N±k under live traffic: scale the keyed vertices up
+                # or down; the harness picks the fence.
+                events.append(ChaosEvent(
+                    float(at_s), "rescale",
+                    targets=(int((2, 4)[int(rng.randint(2))]),)))
             else:                       # nondet
                 events.append(ChaosEvent(float(at_s), "nondet"))
         return cls(events)

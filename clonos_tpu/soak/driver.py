@@ -92,9 +92,14 @@ class SoakHarness:
         self.kills_mid_fence_tail = 0
         #: read tier under test (runtime/serve.ServeTier), attached by
         #: the driver when a serve read load rides the run — the
-        #: ``replica-kill`` fault targets it.
+        #: ``replica-kill`` fault targets it (and a ``rescale`` re-homes
+        #: it onto the new incarnation).
         self.serve_tier = None
         self.replica_kills = 0
+        #: live re-cuts applied (the ``rescale`` chaos event): count and
+        #: per-event handoff stats for the verdict
+        self.rescales = 0
+        self.rescale_stats: List[Dict[str, Any]] = []
 
     # --- fault application ---------------------------------------------------
 
@@ -218,6 +223,60 @@ class SoakHarness:
         self.replica_kills += 1
         self.faults_survived += 1
         self.tracer.event("soak.chaos.replica-kill", replica=idx)
+
+    def _apply_rescale(self, event: ChaosEvent, now_s: float) -> None:
+        # Elastic re-cut under live traffic: at the completing fence the
+        # driver just forced, hand the job off to a new incarnation at
+        # the event's keyed parallelism (fence -> drain -> migrate ->
+        # redirect; runtime/cluster.rescale_live). The control twin is
+        # re-cut identically at the SAME fence, so the ledger diff stays
+        # byte-comparable across the re-cut — exactly-once over a live
+        # repartition is audited, not assumed.
+        target = int(event.targets[0])
+        rescale = getattr(self.runner, "_soak_rescaler", None)
+        if rescale is None:
+            self.tracer.event("soak.chaos.rescale.skipped",
+                              reason="runner has no rescaler attached")
+            return
+        if self._stall_orig is not None:
+            # an active storage stall dies with the old incarnation —
+            # restoring it later onto the NEW runner's storage would
+            # rebind writes to the fenced-off one
+            self.runner.coordinator.storage.write = self._stall_orig
+            self._stall_orig = None
+            for st in self.runner.executor._tier_stores():
+                st.write_delay_s = 0.0
+            self._stall_until = 0.0
+        t0 = _time.monotonic()
+        self.runner, stats = rescale(target)
+        stall_ms = (_time.monotonic() - t0) * 1e3
+        c = self.control
+        if c is not None:
+            while c.executor.epoch_id < stats["from_epoch"]:
+                c.run_epoch(complete_checkpoint=True)
+            c.drain_fence()
+            self.control, _ = c._soak_rescaler(target)
+        if self.serve_tier is not None:
+            # read tier re-homes onto the new incarnation: reads in
+            # the handoff window reroute to live views, never error
+            self.serve_tier.rehome(self.runner)
+        self.rescales += 1
+        self.rescale_stats.append({
+            "target": target,
+            "fence_checkpoint": stats["fence_checkpoint"],
+            "groups": stats["groups"],
+            "drained_records": stats["drained_records"],
+            "moved_key_groups": stats["moved_key_groups"],
+            "fence_stall_ms": round(stall_ms, 1),
+        })
+        # the fence stall is an outage the open-loop client saw:
+        # charge it like a recovery so SLO windows see it
+        self.recoveries_ms.append(stall_ms)
+        self.faults_survived += 1
+        self.tracer.event("soak.chaos.rescaled", target=target,
+                          fence_checkpoint=stats["fence_checkpoint"],
+                          drained=stats["drained_records"],
+                          stall_ms=round(stall_ms, 1))
 
     def _apply_nondet(self, event: ChaosEvent, now_s: float) -> None:
         # Unlogged value perturbation on-device (audit bait): occupied
@@ -345,6 +404,7 @@ class SoakDriver:
             (slo.closed[-1].corrected_ms if slo.closed
              else slo.current.corrected_ms), 0.99), 3))
         g.gauge("audit-ok", lambda: int(not h.divergences))
+        g.gauge("rescales", lambda: h.rescales)
         g.gauge("degraded-workers", lambda: len(
             self.runner.heartbeats.degraded(cfg.degraded_grace_s)))
 
@@ -415,6 +475,7 @@ class SoakDriver:
         ei = 0
         due: List[ChaosEvent] = []
         pending_kills: List[ChaosEvent] = []
+        pending_rescales: List[ChaosEvent] = []
         kill_armed = False       # last fence completed; no pendings
         force_complete = False
         fences = 0
@@ -450,6 +511,12 @@ class SoakDriver:
                     # IGNORE_CHECKPOINT determinants and the digest
                     # chain stays control-comparable (module docstring)
                     pending_kills.append(ev)
+                    force_complete = True
+                elif ev.kind == "rescale":
+                    # a re-cut happens AT a completing fence (the
+                    # protocol's fence phase) — defer like a kill,
+                    # forcing the next fence to complete
+                    pending_rescales.append(ev)
                     force_complete = True
                 else:
                     due.append(ev)
@@ -555,6 +622,24 @@ class SoakDriver:
                             ex.epoch_id - 1)
                     force_complete = False
                     kill_armed = bool(pending_kills)
+                    if pending_rescales:
+                        # the fence completed and drained: the handoff
+                        # point (latest completed checkpoint == this
+                        # fence) exists NOW, before the next chunk
+                        r.drain_fence()
+                        for ev in pending_rescales:
+                            h.apply(ev, now_s)
+                            self.slo.observe_fault(now_s, ev.kind)
+                            if h.recoveries_ms:
+                                self.slo.observe_recovery(
+                                    now_s, h.recoveries_ms[-1])
+                        pending_rescales.clear()
+                        # the harness swapped incarnations underneath
+                        # us: rebind every live handle and re-register
+                        # the gauges on the new runner's registry
+                        r = self.runner = h.runner
+                        ex = r.executor
+                        self._register_gauges()
                 if h.audit_pending:
                     # the fence worker may be mid seal -> ledger
                     # append; diffing now would report a false
@@ -591,6 +676,19 @@ class SoakDriver:
         h.tick(float("inf"))
         r.run_epoch(complete_checkpoint=True)
         r.drain_fence()      # final sweep must see every in-flight seal
+        if pending_rescales:
+            # a re-cut due in the last window still hands off at a real
+            # completed fence (the one just run) — the final audit then
+            # covers the post-re-cut ledger too
+            for ev in pending_rescales:
+                h.apply(ev, now_s)
+                self.slo.observe_fault(now_s, ev.kind)
+                if h.recoveries_ms:
+                    self.slo.observe_recovery(now_s,
+                                              h.recoveries_ms[-1])
+            pending_rescales.clear()
+            r = self.runner = h.runner
+            ex = r.executor
         h.audit_check()
         if self.read_load is not None:
             # one post-drain pump: the final fence sealed, so this burst
@@ -651,6 +749,11 @@ class SoakDriver:
                 # in flight (inject joins it first): each one exercised
                 # the kill-mid-seal drain ordering under load.
                 "kills_mid_fence_tail": h.kills_mid_fence_tail,
+                # live re-cuts (the `rescale` event): per-handoff fence
+                # checkpoint, drained in-flight records, moved key
+                # groups, and the fence-stall cost the paced load paid.
+                "rescales": h.rescales,
+                "rescale_stats": list(h.rescale_stats),
             },
             "audit": {
                 "enabled": audited,
@@ -709,6 +812,16 @@ def next_serve_artifact_path(root: Optional[str] = None) -> str:
     return os.path.join(root, f"SERVE_r{n:02d}.json")
 
 
+def next_rescale_artifact_path(root: Optional[str] = None) -> str:
+    """Next free ``RESCALE_r0N.json`` slot (the ``bench --rescale``
+    verdict artifact, sibling of SOAK/BENCH/SERVE)."""
+    root = root or os.getcwd()
+    n = 1
+    while os.path.exists(os.path.join(root, f"RESCALE_r{n:02d}.json")):
+        n += 1
+    return os.path.join(root, f"RESCALE_r{n:02d}.json")
+
+
 def build_soak_fixture(workdir: str, rate: float, duration_s: float,
                        steps_per_epoch: int = 64, par: int = 2,
                        batch: int = 8, seed: int = 11,
@@ -731,18 +844,26 @@ def build_soak_fixture(workdir: str, rate: float, duration_s: float,
     from clonos_tpu.runtime.executor import DETS_PER_STEP
     from clonos_tpu.runtime.leader import FileLeaderElection
 
-    def build():
+    def build(keyed_par=None):
+        # ``keyed_par`` re-cuts the keyed stages only (the live-rescale
+        # job shape: source and sink keep their parallelism, keyed
+        # vertices move — restore_rescaled's constraint).
         env = StreamEnvironment(name="soak", num_key_groups=16)
         s = (env.synthetic_source(vocab=num_keys, batch_size=batch,
                                   parallelism=par)
              .key_by()
              .window_count(num_keys=num_keys, window_size=1 << 30,
-                           name="window"))
+                           name="window", parallelism=keyed_par))
         if serve_vertex:
             # a KeyedReduceOperator stage (emits_running_value) so the
             # read tier's replicas can tail it to fence freshness
-            s = s.key_by().reduce(num_keys=num_keys, name="reduce")
-        s.sink()
+            s = s.key_by().reduce(num_keys=num_keys, name="reduce",
+                                  parallelism=keyed_par)
+        # the sink keeps its cut across a re-cut (it would otherwise
+        # inherit the keyed stage's), and its input edge is HASH so the
+        # edge type is stable when the upstream parallelism moves —
+        # restore_rescaled re-routes HASH buffers, not FORWARD ones
+        s.key_by().sink(parallelism=par)
         return env.build()
 
     records_per_step = par * batch
@@ -762,11 +883,32 @@ def build_soak_fixture(workdir: str, rate: float, duration_s: float,
             audit=audit, logical_time=True, seed=seed,
             overlap_epoch=overlap)
 
+    def arm_rescaler(r, sub, overlap=False):
+        """Arm a runner for the chaos ``rescale`` event: a closure that
+        re-cuts THIS runner to a new keyed parallelism at its completed
+        fence (ClusterRunner.rescale_live) with the same sizing knobs,
+        then re-arms the new incarnation so repeated re-cuts compose."""
+        def rescale(target):
+            nr, stats = r.rescale_live(
+                build(keyed_par=int(target)),
+                steps_per_epoch=steps_per_epoch,
+                log_capacity=log_capacity, max_epochs=max_epochs,
+                inflight_ring_steps=ring_steps,
+                checkpoint_dir=os.path.join(workdir, sub),
+                audit=audit, logical_time=True, seed=seed,
+                overlap_epoch=overlap)
+            arm_rescaler(nr, sub, overlap)
+            return nr, stats
+        r._soak_rescaler = rescale
+        return r
+
     # Only the soak runner pipelines its fence; the control twin stays
     # strictly sequential, so the ledger diff is always overlapped-vs-
     # sequential — the strongest bit-identity witness available.
-    runner = runner_for("run", overlap_epoch)
-    control = runner_for("control") if audit else None
+    runner = arm_rescaler(runner_for("run", overlap_epoch), "run",
+                          overlap_epoch)
+    control = (arm_rescaler(runner_for("control"), "control")
+               if audit else None)
     election = FileLeaderElection(os.path.join(workdir, "lease"),
                                   "soak-driver", lease_ttl_s=lease_ttl_s)
     election.try_acquire()

@@ -28,9 +28,10 @@ import os
 from typing import Callable, Dict, List, Optional, Tuple
 
 from clonos_tpu.verify.explorer import Action, traces
-from clonos_tpu.verify.models import (FSM_NAMES, AdmissionModel,
-                                      CheckpointModel, LeaseModel,
-                                      RecoveryModel)
+from clonos_tpu.verify.models import (FSM_NAMES, PHASE_NAMES,
+                                      AdmissionModel, CheckpointModel,
+                                      LeaseModel, RecoveryModel,
+                                      RepartitionModel)
 
 
 @dataclasses.dataclass
@@ -485,17 +486,99 @@ def conform_admission(n_traces: int = 3, workers: int = 2,
     return _replay("admission", model, model_traces, Adapter)
 
 
+# --- elastic repartition --------------------------------------------------
+
+def conform_repartition(n_traces: int = 3, workers: int = 2,
+                        epochs: int = 2,
+                        depth: int = 48) -> ConformanceReport:
+    """Drive the real :class:`RescaleCoordinator` — the control plane
+    ``ClusterRunner.rescale_live`` walks through a live re-cut —
+    through model traces. Pre-fence ingest/process are data-plane
+    bookkeeping (``note_inflight``; nothing observable), as is the new
+    incarnation's post-redirect traffic; fence/drain/migrate/redirect
+    must emit exactly the model's transition per step."""
+    from clonos_tpu.runtime.scheduler import RescaleCoordinator
+
+    model = RepartitionModel(workers=workers, epochs=epochs)
+
+    class Adapter:
+        def __init__(self):
+            self.coord = RescaleCoordinator(model.groups)
+            self.obs: List[Tuple] = []
+            self.coord.transition_observers.append(self._on)
+
+        def _on(self, kind, **fields):
+            if kind in ("drain", "migrate"):
+                self.obs.append((kind, fields["group"]))
+            else:
+                self.obs.append((kind,))
+
+        def expected(self, state, action):
+            k, args = action.kind, action.args
+            if k in ("ingest", "process", "ingest_new", "process_new"):
+                return []
+            if k == "fence":
+                return [("fence",)]
+            if k in ("drain", "migrate"):
+                return [(k, args[0])]
+            if k == "redirect":
+                return [("redirect",)]
+            raise ValueError(f"unmapped repartition action {action}")
+
+        def apply(self, state, action):
+            self.obs = []
+            k, args = action.kind, action.args
+            if k == "ingest":
+                self.coord.note_inflight(args[0], 1)
+            elif k == "process":
+                self.coord.note_inflight(args[0], -1)
+            elif k == "fence":
+                self.coord.fence(1)
+            elif k == "drain":
+                self.coord.drain(args[0])
+            elif k == "migrate":
+                self.coord.migrate(args[0])
+            elif k == "redirect":
+                self.coord.redirect()
+            elif k in ("ingest_new", "process_new"):
+                pass        # the NEW incarnation's traffic
+            else:
+                raise ValueError(f"unmapped repartition action {action}")
+            return self.obs
+
+        def projection_drift(self, state):
+            phase, groups = state
+            want_phase = PHASE_NAMES[phase]
+            # model PRE/FENCED/REDIRECTED == coordinator phase names
+            if self.coord.phase != want_phase:
+                return (f"phase={want_phase}",
+                        f"phase={self.coord.phase}")
+            if phase == 2:      # redirected: new incarnation owns state
+                return None
+            for g, (_p, _a, buf, migrated, _l, _s) in enumerate(groups):
+                if self.coord.inflight[g] != buf:
+                    return (f"inflight[{g}]={buf}",
+                            f"inflight[{g}]={self.coord.inflight[g]}")
+                if self.coord.migrated[g] != migrated:
+                    return (f"migrated[{g}]={migrated}",
+                            f"migrated[{g}]={self.coord.migrated[g]}")
+            return None
+
+    model_traces = traces(model, n_traces, depth=depth)
+    return _replay("repartition", model, model_traces, Adapter)
+
+
 def run_conformance(components: Optional[List[str]] = None,
                     n_traces: int = 3, workers: int = 2,
                     epochs: int = 2, faults: int = 1,
                     workdir: Optional[str] = None
                     ) -> Dict[str, ConformanceReport]:
-    """Conformance for the requested components (default: all four).
+    """Conformance for the requested components (default: all five).
     ``workdir`` hosts the lease claim files (a temp dir is created
     when omitted)."""
     import tempfile
     components = list(components or ("checkpoint", "recovery", "lease",
-                                     "admission"))
+                                     "admission", "repartition"))
     out: Dict[str, ConformanceReport] = {}
     for c in components:
         if c == "checkpoint":
@@ -509,6 +592,9 @@ def run_conformance(components: Optional[List[str]] = None,
                                    faults=faults)
         elif c == "admission":
             out[c] = conform_admission(n_traces, workers=workers)
+        elif c == "repartition":
+            out[c] = conform_repartition(n_traces, workers=workers,
+                                         epochs=epochs)
         else:
             raise ValueError(f"unknown component {c!r}")
     return out

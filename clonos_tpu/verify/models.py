@@ -1,4 +1,4 @@
-"""Formal transition models of the four runtime protocols.
+"""Formal transition models of the five runtime protocols.
 
 Each model mirrors ONE real component's protocol — the transitions the
 implementation exposes to its driver — at the smallest state that
@@ -18,6 +18,13 @@ preserves the safety argument:
 - :class:`AdmissionModel` — runtime/dispatcher.py's
   ``AdmissionController``: per-tenant quota charged on reservation
   (held + queued), strict-FIFO head-blocking queue, cancel/release.
+- :class:`RepartitionModel` — the live re-cut protocol
+  (runtime/cluster.py's ``rescale_live`` driven by the
+  ``RescaleCoordinator``): at a completed checkpoint fence the old
+  incarnation stops ingesting, drains each key group's in-flight edge
+  records into its state, migrates the drained groups to the N±k
+  incarnation, and only then redirects traffic — exactly once per
+  record across the fence.
 
 ``bug=`` injects a named, intentional protocol defect (see ``BUGS``).
 Each seeded bug reproduces a hazard the real protocol's discipline
@@ -60,6 +67,21 @@ BUGS: Dict[str, Dict[str, str]] = {
         "cancel-leaks-quota": "cancelling a queued job forgets to "
                               "release its reservation charge — the "
                               "tenant's quota leaks",
+    },
+    "repartition": {
+        "migrate-skips-drain": "a key group may migrate while its "
+                               "in-flight edge records are still "
+                               "buffered — the leftovers die with the "
+                               "old incarnation at redirect (records "
+                               "lost)",
+        "redirect-before-migrate": "traffic redirects before every "
+                                   "group has migrated — unmigrated "
+                                   "groups restart empty on the new "
+                                   "incarnation (state and in-flight "
+                                   "records lost)",
+        "stale-writer": "the old incarnation keeps applying to a "
+                        "group it already handed off — the new owner "
+                        "replays the same records (duplicates)",
     },
 }
 
@@ -677,10 +699,185 @@ class AdmissionModel(Model):
         return None
 
 
+# --- elastic repartition --------------------------------------------------
+
+#: repartition phases (state[0])
+_PRE, _FENCED, _REDIRECTED = range(3)
+PHASE_NAMES = ("PRE", "FENCED", "REDIRECTED")
+
+
+class RepartitionModel(Model):
+    """The live re-cut handoff: fence → drain → migrate → redirect.
+
+    One key group per old worker (``workers`` groups; groups are
+    symmetric so one per worker preserves the argument). Records flow
+    per group as ``ingest`` (old incarnation admits a record onto the
+    group's in-flight edge) and ``process`` (the record is applied to
+    the group's keyed state). The re-cut:
+
+    - ``fence`` — a checkpoint fence completes; the old incarnation
+      stops admitting new records (carries the ``rescale`` chaos hint:
+      replaying a model counterexample on the live system re-cuts to
+      ``workers + 1``).
+    - ``drain(g)`` — a buffered in-flight record of group ``g`` is
+      applied by the old incarnation (edge drain before handoff).
+    - ``migrate(g)`` — group ``g``'s keyed state moves to the new
+      incarnation; legal only once its edge buffer is empty.
+    - ``redirect`` — traffic cuts over to the new incarnation; legal
+      only once EVERY group has migrated. Whatever the old incarnation
+      still buffers dies with it, and an unmigrated group restarts
+      empty — the model charges both to ``lost`` so the seeded bugs
+      that reach this state are caught by the invariant, not by fiat.
+
+    After redirect the new incarnation ingests/processes fresh traffic;
+    bug ``stale-writer`` lets the OLD incarnation re-apply a record of
+    a group it already handed off (the duplicate hazard fencing-token
+    discipline exists to prevent).
+
+    State: ``(phase, groups)`` with per-group
+    ``(produced, applied, buf, migrated, lost, stale)``.
+
+    Invariants:
+
+    - **no-record-lost** — no group ever loses a record across the
+      re-cut fence (``lost == 0`` everywhere).
+    - **no-record-duplicated** — no group applies more records than
+      were produced for it (``applied + buf + lost <= produced``).
+    """
+
+    name = "repartition"
+
+    def __init__(self, workers: int = 2, epochs: int = 2,
+                 faults: int = 1, bug: Optional[str] = None):
+        del faults              # the re-cut itself is the disturbance
+        self.groups = max(2, int(workers))
+        self.pre_cap = max(1, int(epochs))   # per-group records pre-fence
+        self.post_cap = 1                    # per-group records post-cut
+        self.bug = _check_bug("repartition", bug)
+
+    def initial_state(self):
+        return (_PRE, ((0, 0, 0, False, 0, False),) * self.groups)
+
+    def enabled(self, state) -> List[Action]:
+        phase, groups = state
+        out: List[Action] = []
+        if phase == _PRE:
+            for g, (prod, _a, buf, _m, _l, _s) in enumerate(groups):
+                if prod < self.pre_cap:
+                    out.append(Action("ingest", (g,)))
+                if buf > 0:
+                    out.append(Action("process", (g,)))
+            out.append(Action(
+                "fence", (),
+                chaos=("rescale", (("targets", (self.groups + 1,)),))))
+        elif phase == _FENCED:
+            all_migrated = all(m for _p, _a, _b, m, _l, _s in groups)
+            for g, (_p, _a, buf, migrated, _l, _s) in enumerate(groups):
+                if buf > 0:
+                    out.append(Action("drain", (g,)))
+                if not migrated and (
+                        buf == 0 or self.bug == "migrate-skips-drain"):
+                    out.append(Action("migrate", (g,)))
+            if all_migrated or self.bug == "redirect-before-migrate":
+                out.append(Action("redirect"))
+        else:                   # _REDIRECTED
+            for g, (prod, applied, buf, _m, _l, stale) in \
+                    enumerate(groups):
+                if prod < self.pre_cap + self.post_cap:
+                    out.append(Action("ingest_new", (g,)))
+                if buf > 0:
+                    out.append(Action("process_new", (g,)))
+                if (self.bug == "stale-writer" and applied > 0
+                        and not stale):
+                    out.append(Action("stale_write", (g,)))
+        return out
+
+    def apply(self, state, action: Action):
+        phase, groups = state
+        groups = list(groups)
+        k = action.kind
+        if k == "fence":
+            phase = _FENCED
+        elif k == "redirect":
+            phase = _REDIRECTED
+            # The old incarnation's leftovers die with it; an
+            # unmigrated group's state never reached the new owner.
+            for g, (prod, applied, buf, migrated, lost, stale) in \
+                    enumerate(groups):
+                if not migrated:
+                    lost += applied
+                    applied = 0
+                lost += buf
+                buf = 0
+                groups[g] = (prod, applied, buf, True, lost, stale)
+        else:
+            g = action.args[0]
+            prod, applied, buf, migrated, lost, stale = groups[g]
+            if k in ("ingest", "ingest_new"):
+                prod += 1
+                buf += 1
+            elif k in ("process", "process_new", "drain"):
+                applied += 1
+                buf -= 1
+            elif k == "migrate":
+                migrated = True
+            elif k == "stale_write":
+                applied += 1    # re-applies a record already handed off
+                stale = True
+            else:
+                raise ValueError(f"bad action {action}")
+            groups[g] = (prod, applied, buf, migrated, lost, stale)
+        return (phase, tuple(groups))
+
+    def invariants(self):
+        def no_loss(state):
+            _phase, groups = state
+            lost = {g: gl for g, (_p, _a, _b, _m, gl, _s)
+                    in enumerate(groups) if gl}
+            if lost:
+                return (f"group(s) {sorted(lost)} lost {lost} "
+                        f"record(s) across the re-cut fence — "
+                        f"in-flight or keyed state never reached the "
+                        f"new incarnation")
+            return None
+
+        def no_dup(state):
+            _phase, groups = state
+            for g, (prod, applied, buf, _m, lost, _s) in \
+                    enumerate(groups):
+                if applied + buf + lost > prod:
+                    return (f"group {g} accounts for "
+                            f"{applied + buf + lost} records but only "
+                            f"{prod} were produced — a record was "
+                            f"applied twice across the handoff")
+            return None
+
+        return [("no-record-lost", no_loss),
+                ("no-record-duplicated", no_dup)]
+
+    def canon(self, state):
+        """Key groups are symmetric: sort the per-group tuples."""
+        phase, groups = state
+        return (phase, tuple(sorted(groups)))
+
+    def settled(self, state) -> Optional[str]:
+        phase, groups = state
+        if phase != _REDIRECTED:
+            return (f"re-cut wedged in {PHASE_NAMES[phase]} — the old "
+                    f"incarnation never handed off")
+        undrained = [g for g, (_p, _a, b, _m, _l, _s)
+                     in enumerate(groups) if b]
+        if undrained:
+            return (f"group(s) {undrained} finished with buffered "
+                    f"records never applied")
+        return None
+
+
 #: registry: CLI/runner model names -> constructor
 MODELS = {
     "checkpoint": CheckpointModel,
     "recovery": RecoveryModel,
     "lease": LeaseModel,
     "admission": AdmissionModel,
+    "repartition": RepartitionModel,
 }

@@ -436,6 +436,67 @@ def test_audit_cli_prints_and_diffs_ledgers(tmp_path, capsys):
     assert main(["audit", str(tmp_path / "empty")]) == 1
 
 
+def test_audit_cli_diff_across_partition_layouts(tmp_path, capsys):
+    """`clonos_tpu audit A --diff B` across two DIFFERENTLY-partitioned
+    runs of one job: epochs stamped with different layouts compare
+    through the group-directory mapping on the partition-invariant
+    channels (ring counts + ringsum content), so a clean re-cut diffs
+    empty where the exact byte diff would refuse."""
+    from clonos_tpu.cli import main
+
+    def write_ledger(dirpath, entries):
+        os.makedirs(dirpath, exist_ok=True)
+        with open(os.path.join(dirpath, "ledger.jsonl"), "w") as f:
+            for e in entries:
+                f.write(json.dumps(e) + "\n")
+
+    SUM = (123456789).to_bytes(8, "little")
+
+    def entry(epoch, layout, ring_chunks, ringsum=SUM):
+        # lanes differ per cut, so chunking (and ring/ fp) differ; the
+        # record multiset — count and content sum — must not
+        d = EpochDigest(epoch, layout=layout)
+        n_lanes = dict(layout)[1]
+        for flat in range(sum(p for _, p in layout)):
+            d.fold(f"log/{flat}", b"rows-%d-%d" % (flat, n_lanes), 1)
+        total = 0
+        for chunk, n in ring_chunks:
+            d.fold("ring/v1", chunk, n)
+            total += n
+        d.fold("ringsum/v1", ringsum, total)
+        return d.to_entry()
+
+    two = ((0, 1), (1, 2))
+    four = ((0, 1), (1, 4))
+    a = tmp_path / "a"
+    b = tmp_path / "b"
+    c = tmp_path / "c"
+    write_ledger(str(a / "g0"),
+                 [entry(0, two, [(b"aa", 2), (b"bb", 2)])])
+    write_ledger(str(b / "g0"),
+                 [entry(0, four, [(b"x", 1)] * 4)])
+    write_ledger(str(c / "g0"),
+                 [entry(0, four, [(b"x", 1)] * 4,
+                        ringsum=(99).to_bytes(8, "little"))])
+
+    # the exact byte diff refuses across cuts...
+    ea = [json.loads(line) for line in
+          (a / "g0" / "ledger.jsonl").read_text().splitlines()]
+    eb = [json.loads(line) for line in
+          (b / "g0" / "ledger.jsonl").read_text().splitlines()]
+    assert diff_ledgers(ea, eb)
+
+    # ...but the CLI's mapped diff sees one job, cut two ways
+    assert main(["audit", str(a), "--diff", str(b)]) == 0
+    assert "ledgers match" in capsys.readouterr().out
+
+    # a record lost AND another duplicated (count matches, content
+    # moved) is still named, epoch + channel
+    assert main(["audit", str(a), "--diff", str(c)]) == 1
+    out = capsys.readouterr().out
+    assert "epoch 0" in out and "ringsum/v1" in out and "content sum" in out
+
+
 def test_marker_lint_passes_and_flags_unregistered(tmp_path):
     sys.path.insert(0, os.path.join(REPO, "tools"))
     try:

@@ -139,3 +139,143 @@ def test_rescale_rejects_non_hash_edges():
         ClusterRunner.restore_rescaled(
             job_new, job_old, r.standbys.latest, steps_per_epoch=4,
             log_capacity=128, max_epochs=8, inflight_ring_steps=8, seed=1)
+
+
+# --- cold paths: guards and state surgery ------------------------------------
+
+
+def _cap_job(window_p: int, cap: int):
+    env = StreamEnvironment(name=f"cap-{window_p}-{cap}",
+                            num_key_groups=16, default_edge_capacity=cap)
+    (env.synthetic_source(vocab=VOCAB, batch_size=8, parallelism=2)
+        .key_by()
+        .window_count(num_keys=VOCAB, window_size=7,
+                      parallelism=window_p, name="w")
+        .key_by()
+        .sink(parallelism=2))
+    return env.build()
+
+
+def test_restore_rescaled_topology_mismatch():
+    """A re-cut is a repartition, not a redeploy: a job with a
+    different vertex/edge count must be refused loudly."""
+    env = StreamEnvironment(name="topo", num_key_groups=16,
+                            default_edge_capacity=96)
+    (env.synthetic_source(vocab=VOCAB, batch_size=8, parallelism=2)
+        .key_by().reduce(num_keys=VOCAB, parallelism=2, name="r")
+        .key_by().sink(parallelism=2))
+    job_short = env.build()
+    r = ClusterRunner(_job(2, 2), steps_per_epoch=4, log_capacity=256,
+                      max_epochs=8, inflight_ring_steps=16, seed=1)
+    r.run_epoch(complete_checkpoint=True)
+    with pytest.raises(rec.RecoveryError, match="topology mismatch"):
+        ClusterRunner.restore_rescaled(
+            job_short, r.job, r.standbys.latest, steps_per_epoch=4,
+            log_capacity=256, max_epochs=8, inflight_ring_steps=16,
+            seed=1)
+
+
+def test_restore_rescaled_edge_buffer_overflow_fails_loud():
+    """Rescaling DOWN concentrates old lanes' in-flight records; if the
+    new cut's edge capacity cannot hold them the restore must raise —
+    silently dropping them would break the identical-output contract."""
+    r = ClusterRunner(_cap_job(4, 96), steps_per_epoch=5,
+                      log_capacity=256, max_epochs=8,
+                      inflight_ring_steps=16, seed=2)
+    r.run_epoch(complete_checkpoint=True)
+    buf = r.standbys.latest.carry.edge_bufs[0]
+    assert int(np.asarray(buf.valid).sum()) > 8, \
+        "fixture must capture enough in-flight records to overflow"
+    with pytest.raises(rec.RecoveryError, match="overflows capacity"):
+        ClusterRunner.restore_rescaled(
+            _cap_job(1, 8), _cap_job(4, 8), r.standbys.latest,
+            steps_per_epoch=5, log_capacity=256, max_epochs=8,
+            inflight_ring_steps=16, seed=2)
+
+
+def test_rescale_keyed_state_roundtrip_up_down():
+    """rescale_keyed_state up then back down is the identity on a real
+    run's keyed operator states: the split/merge moves every row to its
+    key-group owner and conserves content, so returning to the original
+    cut returns the original tables."""
+    import jax
+
+    r = ClusterRunner(_job(2, 2), steps_per_epoch=6, log_capacity=256,
+                      max_epochs=8, inflight_ring_steps=16, seed=7)
+    r.run_epoch(complete_checkpoint=True)
+    G = r.job.num_key_groups
+    for vid in (1, 2):                          # window, reduce
+        op = r.job.vertices[vid].operator
+        st = r.executor.carry.op_states[vid]
+        up = op.rescale_keyed_state(st, 4, G)
+        back = op.rescale_keyed_state(up, 2, G)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b)), st, back)
+
+
+# --- the live re-cut (rescale_live) ------------------------------------------
+
+
+def test_rescale_live_handoff_exactly_once(tmp_path):
+    """Elastic repartition under live traffic, end to end: a 2->4
+    re-cut at a completed fence produces sink output identical to a
+    never-rescaled control, the protocol transitions fire in verified
+    order (fence -> drain -> migrate -> redirect), the old incarnation
+    is fenced off, the cross-layout ledger diff is clean while the
+    exact diff refuses (the mapped path engaged), and a failure AFTER
+    the re-cut recovers at the new parallelism."""
+    from clonos_tpu.obs import audit as audit_mod
+    from clonos_tpu.obs.digest import diff_ledgers
+
+    kw = dict(steps_per_epoch=6, log_capacity=256, max_epochs=8,
+              inflight_ring_steps=16, seed=11)
+    ctl = ClusterRunner(_job(2, 2), checkpoint_dir=str(tmp_path / "a"),
+                        audit=True, **kw)
+    ctl.executor.time_source = TickTime()
+    want = _collect_sink(ctl, 4, complete=True)
+
+    r = ClusterRunner(_job(2, 2), checkpoint_dir=str(tmp_path / "b"),
+                      audit=True, **kw)
+    r.executor.time_source = TickTime()
+    got = _collect_sink(r, 1, complete=True)
+    r2, stats = r.rescale_live(_job(4, 4),
+                               checkpoint_dir=str(tmp_path / "b"),
+                               audit=True, **kw)
+    got += _collect_sink(r2, 3, complete=True)
+    assert sorted(got) == want and len(want) > 0
+
+    kinds = [k for k, _ in stats["transitions"]]
+    assert kinds[0] == "fence" and kinds[-1] == "redirect"
+    assert kinds.count("migrate") == stats["groups"]
+    assert stats["drained_records"] > 0
+    assert stats["moved_key_groups"] and all(
+        m > 0 for m in stats["moved_key_groups"].values())
+
+    with pytest.raises(rec.RecoveryError):
+        r.run_epoch()                    # stale writer: fenced off
+
+    # exactly-once across the cut, via the audit layer's group mapping
+    assert audit_mod.diff_ledgers_cross(ctl.auditor.ledger(),
+                                        r2.auditor.ledger()) == []
+    assert diff_ledgers(ctl.auditor.ledger(), r2.auditor.ledger()), \
+        "exact diff must refuse across layouts (mapped path engaged)"
+
+    # a failure AFTER the re-cut recovers at the new parallelism
+    r2.inject_failure([2])
+    assert r2.recover() is not None
+
+
+def test_rescale_live_guards_refuse_bad_fences(tmp_path):
+    """The protocol guards the model checks: no completed checkpoint,
+    or a mid-epoch caller, cannot start a re-cut."""
+    kw = dict(steps_per_epoch=6, log_capacity=256, max_epochs=8,
+              inflight_ring_steps=16, seed=3)
+    r = ClusterRunner(_job(2, 2), checkpoint_dir=str(tmp_path),
+                      **kw)
+    with pytest.raises(rec.RecoveryError, match="no completed"):
+        r.rescale_live(_job(4, 4), checkpoint_dir=str(tmp_path), **kw)
+    r.run_epoch(complete_checkpoint=True)
+    r.step()                             # mid-epoch now
+    with pytest.raises(rec.RecoveryError, match="mid-epoch"):
+        r.rescale_live(_job(4, 4), checkpoint_dir=str(tmp_path), **kw)

@@ -237,3 +237,64 @@ def test_serve_window_lint_rule():
     src = open(path).read()
     assert "clonos: serve-window-begin" in src
     assert rule.check(FileContext(path, src)) == []
+
+
+def test_serve_tier_rehomes_across_live_recut(tmp_path):
+    """Elastic re-cut under a live read tier: while the job is re-cut
+    2->4 keyed workers (ClusterRunner.rescale_live), reads in the
+    handoff window keep answering the last fence — reroute/degrade,
+    never a client-visible error — and after ``tier.rehome(new)`` the
+    replica re-adopts in the NEW shape and serves the next fences with
+    the owner's exact values and epoch stamps."""
+    def recut_job(keyed_par):
+        env = StreamEnvironment(name=f"serve-recut-{keyed_par}",
+                                num_key_groups=16,
+                                default_edge_capacity=64)
+        (env.synthetic_source(vocab=NUM_KEYS, batch_size=8,
+                              parallelism=2)
+            .key_by().reduce(num_keys=NUM_KEYS, parallelism=keyed_par,
+                             name="r")
+            .key_by().sink(parallelism=2))
+        return env.build()
+
+    kw = dict(steps_per_epoch=4, log_capacity=256, max_epochs=8,
+              inflight_ring_steps=16, seed=3)
+    r = ClusterRunner(recut_job(2), checkpoint_dir=str(tmp_path), **kw)
+    tier = build_serve_tier(r, VID, n_replicas=1)
+    try:
+        keys = list(range(NUM_KEYS))
+        r.run_epoch(complete_checkpoint=True)
+        r.drain_fence()
+        before = tier.clients[0].query_batch(VID, keys)
+
+        r2, stats = r.rescale_live(recut_job(4),
+                                   checkpoint_dir=str(tmp_path), **kw)
+        assert stats["transitions"][-1][0] == "redirect"
+
+        # handoff window: the tier still points at the fenced-off
+        # incarnation — reads must answer the last fence, not error
+        mid = tier.clients[0].query_batch(VID, keys)
+        assert mid["epoch"] == before["epoch"]
+        assert mid["values"] == before["values"]
+
+        tier.rehome(r2)
+        # the replica re-adopted from the new-shape restore point the
+        # re-cut fenced at the same checkpoint id: same fence, served
+        again = tier.clients[0].query_batch(VID, keys)
+        assert again["epoch"] == before["epoch"]
+        assert again["values"] == before["values"]
+
+        # new fences under the new cut: replica matches the owner
+        # bit for bit and the ownership map is the 4-wide one
+        r2.run_epoch(complete_checkpoint=True)
+        r2.drain_fence()
+        after = tier.clients[0].query_batch(VID, keys)
+        owner = tier.owner_client.query_batch(VID, keys)
+        assert after["epoch"] > before["epoch"]
+        assert after["epoch"] == owner["epoch"]
+        assert after["values"] == owner["values"]
+        assert after["subtasks"] == owner["subtasks"]
+        assert max(owner["subtasks"]) > 1, "4-wide ownership visible"
+        assert after["staleness_epochs"] == 0
+    finally:
+        tier.close()

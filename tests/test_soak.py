@@ -68,10 +68,35 @@ def test_dsl_sorts_events_by_fire_time():
     "at 5s stall delay=200ms for",       # 'for' needs a duration
     "at 5m kill 1",                      # bad duration unit
     "at 5s kill 1 bogus=1",              # unexpected token
+    "at 5s rescale",                     # rescale needs a target cut
+    "at 5s rescale 0",                   # target must be >= 1 worker
+    "at 5s rescale 2 4",                 # exactly one target
 ])
 def test_dsl_rejects_malformed_events(line):
     with pytest.raises(ValueError):
         parse_schedule(line)
+
+
+def test_dsl_rescale_parses_and_roundtrips():
+    """`rescale N` is a first-class chaos kind: the target cut rides in
+    targets, and to_text() reproduces the line byte-exactly."""
+    text = "at 1.5s rescale 4"
+    sched = parse_schedule(text)
+    (ev,) = list(sched)
+    assert ev.kind == "rescale" and ev.targets == (4,)
+    assert sched.to_text() == text
+    assert parse_schedule(sched.to_text()) == sched
+
+
+def test_seeded_schedule_can_draw_rescales():
+    sched = ChaosSchedule.seeded(7, 60.0, [0, 1], kinds=("rescale",),
+                                 n_events=3)
+    assert len(sched) == 3
+    assert all(e.kind == "rescale" and e.targets[0] in (2, 4)
+               for e in sched)
+    assert parse_schedule(sched.to_text()) == sched
+    assert ChaosSchedule.seeded(7, 60.0, [0, 1], kinds=("rescale",),
+                                n_events=3) == sched
 
 
 def test_seeded_schedule_is_replayable():
@@ -495,6 +520,41 @@ def test_soak_injected_nondet_fails_the_run(tmp_path):
     assert v["audit"]["divergences"]
     assert any("ring" in d for d in v["audit"]["divergences"])
     assert runner.metrics.snapshot()["soak.audit-ok"] == 0
+
+
+@pytest.mark.slow
+def test_soak_mid_run_rescale_holds_exactly_once(tmp_path):
+    """Elastic repartition under live soak traffic: a `rescale 4` event
+    re-cuts the running 2-wide job to 4 keyed workers at a completing
+    fence. The control twin is re-cut identically, so the byte-exact
+    ledger diff must stay empty across the handoff — no record lost or
+    duplicated — and the driver must keep pacing the NEW incarnation."""
+    from clonos_tpu.soak import SLOSpec, SoakConfig, SoakDriver
+
+    runner, control, election = _fixture(tmp_path, duration_s=4.0,
+                                         rate=4000.0)
+    driver = SoakDriver(
+        runner, SoakConfig(rate=4000.0, duration_s=4.0, window_s=1.0,
+                           chunk_steps=8, complete_every=2),
+        schedule=parse_schedule("at 1.2s rescale 4"),
+        spec=SLOSpec(exactly_once=True),
+        control=control, election=election, records_per_step=16)
+    v = driver.run()
+
+    assert v["pass"] is True
+    assert v["audit"]["exactly_once"] is True
+    assert v["audit"]["divergences"] == []
+    assert v["audit"]["epochs_checked"] > 0
+    assert v["faults"]["rescales"] == 1
+    (stats,) = v["faults"]["rescale_stats"]
+    assert stats["target"] == 4
+    assert stats["drained_records"] >= 0
+    assert sum(stats["moved_key_groups"].values()) > 0
+    assert stats["fence_stall_ms"] >= 0.0
+    # the driver really swapped to the re-cut incarnation
+    assert driver.runner is not runner
+    assert any(vx.parallelism == 4 for vx in driver.runner.job.vertices)
+    assert driver.runner.metrics.snapshot()["soak.rescales"] == 1
 
 
 @pytest.mark.slow

@@ -143,3 +143,43 @@ def test_file_sink_sweeps_stale_pendings_on_restart(tmp_path):
     fs2 = r2.attach_file_sink(_sink_vid(r2), root)
     assert not any(f.endswith(".pending") for f in os.listdir(root))
     np.testing.assert_array_equal(fs2.read_committed(), committed_before)
+
+
+def test_file_sink_sweep_is_token_fenced(tmp_path):
+    """The unfenced-sweep bug, pinned: during a handoff (live re-cut,
+    standby takeover) two incarnations briefly share one sink root. A
+    STALE sweeper (older fencing token) must never delete the newer
+    writer's in-progress pendings or temp files; a NEWER sweeper
+    removes a fenced-off predecessor's pendings regardless of
+    keep_epochs; and commit never certifies a successor's parts."""
+    import os
+    from clonos_tpu.runtime.filesink import FileSystemSink
+
+    root = str(tmp_path / "shared")
+    old = FileSystemSink(root, token=0)
+    new = FileSystemSink(root, token=1)
+    old.write_pending(3, {0: np.arange(6).reshape(2, 3)})
+    new.write_pending(4, {0: np.arange(9).reshape(3, 3)})
+    orphan = os.path.join(root, "part-5-0-t1.pending.tmp")
+    open(orphan, "wb").close()
+
+    # stale sweeper: only its own (token-0) pendings go; the newer
+    # incarnation's pending AND temp orphan survive
+    removed = old.sweep_pending()
+    assert removed == ["part-3-0-t0.pending"]
+    assert sorted(os.listdir(root)) == ["part-4-0-t1.pending",
+                                       "part-5-0-t1.pending.tmp"]
+
+    # the stale writer completing its checkpoint must not certify the
+    # successor's epoch-4 pending either
+    old.commit(4, None)
+    assert new.committed_epochs() == []
+
+    # newer sweeper: the predecessor's pendings are always dead — even
+    # ones keep_epochs would retain at its own token
+    old.write_pending(4, {1: np.arange(3).reshape(1, 3)})
+    removed = new.sweep_pending(keep_epochs=[4])
+    assert removed == ["part-4-1-t0.pending", "part-5-0-t1.pending.tmp"]
+    assert sorted(os.listdir(root)) == ["part-4-0-t1.pending"]
+    new.commit(4, None)
+    assert new.committed_epochs() == [4]
