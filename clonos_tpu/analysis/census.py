@@ -55,6 +55,7 @@ SERVICE_CALLS = {
     "serializable_service": "SERIALIZABLE",
     "timer_service": "TIMER_TRIGGER",
     "append_async_determinant": "ASYNC_ROW",
+    "append_scale_determinant": "SCALE",
 }
 
 #: block-context attributes whose read consumes a logged determinant.

@@ -1,0 +1,37 @@
+"""Deterministic autoscaler: the policy engine that closes the loop on
+``rescale_live`` and the serve tier.
+
+Three layers (ISSUE 16 / ROADMAP "Autoscaling"):
+
+- :mod:`autoscale.signals` — rolling-window aggregation of the metrics
+  the system already exports into a typed, quantized ``ScaleSignals``
+  snapshot per completed fence;
+- :mod:`autoscale.policy` — a pure, deterministic
+  ``ScalePolicy.decide(signals, state)`` with hysteresis, sustain
+  windows, cooldowns, and bounded step size;
+- :mod:`autoscale.controller` — fence-aligned evaluation that logs
+  every decision as a ``SCALE`` determinant (plus the signal snapshot
+  it saw) BEFORE acting, so recovery replays decisions bit-identically
+  instead of re-deciding, and executes re-cuts through the PR 15
+  fence→drain→migrate→redirect path.
+
+Design-first verification lives in verify/models.ScalePolicyModel (the
+sixth model) with conformance replay through the real controller.
+"""
+
+from clonos_tpu.autoscale.signals import (DEFAULT_WINDOW,  # noqa: F401
+                                          ScaleSignals, SignalAggregator,
+                                          signals_for_level)
+from clonos_tpu.autoscale.policy import (ACTION_CODES, HOLD,  # noqa: F401
+                                         SCALE_REPLICAS, SCALE_WORKERS,
+                                         PolicyConfig, PolicyState,
+                                         ScaleDecision, ScalePolicy)
+from clonos_tpu.autoscale.controller import (AutoscaleController,  # noqa: F401
+                                             DecisionLog, decision_row)
+
+__all__ = [
+    "DEFAULT_WINDOW", "ScaleSignals", "SignalAggregator",
+    "signals_for_level", "ACTION_CODES", "HOLD", "SCALE_REPLICAS",
+    "SCALE_WORKERS", "PolicyConfig", "PolicyState", "ScaleDecision",
+    "ScalePolicy", "AutoscaleController", "DecisionLog", "decision_row",
+]

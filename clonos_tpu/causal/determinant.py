@@ -45,11 +45,12 @@ TIMER_TRIGGER = 4
 SOURCE_CHECKPOINT = 5
 IGNORE_CHECKPOINT = 6
 BUFFER_BUILT = 7
+SCALE = 8
 
-NUM_TAGS = 8
+NUM_TAGS = 9
 TAG_NAMES = (
     "ORDER", "TIMESTAMP", "RNG", "SERIALIZABLE", "TIMER_TRIGGER",
-    "SOURCE_CHECKPOINT", "IGNORE_CHECKPOINT", "BUFFER_BUILT",
+    "SOURCE_CHECKPOINT", "IGNORE_CHECKPOINT", "BUFFER_BUILT", "SCALE",
 )
 
 # Tags whose effect fires at a target record count during replay
@@ -287,6 +288,47 @@ class BufferBuiltDeterminant(Determinant):
         return cls(num_records=int(row[LANE_P]))
 
 
+@dataclasses.dataclass(frozen=True)
+class ScaleDeterminant(Determinant):
+    """One autoscaling decision, logged before it acts.
+
+    The paper's rule for nondeterministic control events (timer firings,
+    checkpoint RPC arrivals) extends to autonomous scaling: the decision
+    is recorded as a determinant so a recovered controller REPLAYS it
+    instead of re-deciding — a re-decide against slightly different
+    post-recovery signals would re-cut the cluster twice. ``record_count``
+    carries the decision sequence number (nonzero, so a SCALE row can
+    never masquerade as a per-step sync anchor); ``signal_crc`` pins the
+    exact :class:`~clonos_tpu.autoscale.signals.ScaleSignals` snapshot the
+    policy saw (full snapshot in the decision log's JSONL sidecar, same
+    sidecar discipline as SERIALIZABLE). Not an ASYNC_TAG: SCALE rows live
+    in the controller's own host-side log, never in a task's replayable
+    determinant stream.
+    """
+
+    TAG: ClassVar[int] = SCALE
+    record_count: int = 0      # decision sequence number (1-based)
+    epoch: int = 0             # completed fence the decision was made at
+    action: int = 0            # 0 hold / 1 scale-workers / 2 scale-replicas
+    delta: int = 0             # signed step (bounded by policy max_step)
+    target: int = 0            # resulting worker/replica count
+    signal_crc: int = 0        # crc32 of the canonical signal snapshot
+
+    def _payload(self):
+        ehi, elo = split64(self.epoch)
+        return (ehi, elo, self.action, self.delta, self.target,
+                self.signal_crc)
+
+    @classmethod
+    def _from_row(cls, row):
+        return cls(record_count=int(row[LANE_RC]),
+                   epoch=join64(int(row[LANE_P]), int(row[LANE_P + 1])),
+                   action=int(row[LANE_P + 2]),
+                   delta=int(row[LANE_P + 3]),
+                   target=int(row[LANE_P + 4]),
+                   signal_crc=int(row[LANE_P + 5]) & _I32_MASK)
+
+
 _TAG_TO_CLASS: Dict[int, Type[Determinant]] = {
     ORDER: OrderDeterminant,
     TIMESTAMP: TimestampDeterminant,
@@ -296,6 +338,7 @@ _TAG_TO_CLASS: Dict[int, Type[Determinant]] = {
     SOURCE_CHECKPOINT: SourceCheckpointDeterminant,
     IGNORE_CHECKPOINT: IgnoreCheckpointDeterminant,
     BUFFER_BUILT: BufferBuiltDeterminant,
+    SCALE: ScaleDeterminant,
 }
 
 

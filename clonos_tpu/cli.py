@@ -645,6 +645,21 @@ def _top_table(snap) -> str:
         lines.append("")
         lines.append("serve: " + "  ".join(
             f"{k}={v}" for k, v in sorted(serve.items())))
+    # Autoscale status row: the closed-loop controller's autoscale.*
+    # gauges (decision/action tallies, cooldown, target vs actual cut)
+    # — same suffix matching as soak:/serve:.
+    autoscale = {}
+    for k, v in sorted(snap.items()):
+        if not isinstance(v, (int, float)) or isinstance(v, bool):
+            continue
+        if k.startswith("autoscale."):
+            autoscale[k[len("autoscale."):]] = v
+        elif ".autoscale." in k:
+            autoscale.setdefault(k.rsplit(".autoscale.", 1)[1], v)
+    if autoscale:
+        lines.append("")
+        lines.append("autoscale: " + "  ".join(
+            f"{k}={v}" for k, v in sorted(autoscale.items())))
     tenant = {k: v for k, v in sorted(snap.items())
               if (k.startswith("tenant.")
                   or k.startswith("dispatcher."))
@@ -959,6 +974,7 @@ def cmd_soak(args) -> int:
     from clonos_tpu.soak import (ChaosSchedule, SLOSpec, SoakConfig,
                                  SoakDriver, build_soak_fixture,
                                  default_kill_targets,
+                                 next_autoscale_artifact_path,
                                  next_soak_artifact_path, parse_schedule)
 
     tracer = _setup_tracer(args, "soak")
@@ -994,9 +1010,25 @@ def cmd_soak(args) -> int:
                      window_s=args.window,
                      chunk_steps=args.chunk_steps,
                      complete_every=args.complete_every)
+    autoscaler = None
+    if args.autoscale:
+        # the closed loop: a deterministic policy engine evaluates at
+        # every completed fence and re-cuts the job itself (zero
+        # operator rescale events); every decision + signal snapshot
+        # lands in the SCALE determinant log under the workdir, so a
+        # recovered controller REPLAYS it instead of re-deciding.
+        from clonos_tpu.autoscale import (AutoscaleController,
+                                          DecisionLog, PolicyConfig,
+                                          ScalePolicy)
+        autoscaler = AutoscaleController(
+            ScalePolicy(PolicyConfig(
+                min_workers=1, max_workers=max(args.parallelism * 2,
+                                               args.parallelism + 2))),
+            log=DecisionLog(os.path.join(workdir, "scale.det")))
     driver = SoakDriver(runner, cfg, schedule=schedule, spec=spec,
                         control=control, election=election,
-                        records_per_step=args.parallelism * args.batch)
+                        records_per_step=args.parallelism * args.batch,
+                        autoscaler=autoscaler)
 
     endpoint = None
     if args.metrics_port is not None:
@@ -1013,14 +1045,16 @@ def cmd_soak(args) -> int:
         if endpoint is not None:
             endpoint.close()
 
-    out_path = args.out or next_soak_artifact_path()
+    out_path = args.out or (next_autoscale_artifact_path()
+                            if args.autoscale
+                            else next_soak_artifact_path())
     with open(out_path, "w") as f:
         json.dump(verdict, f, indent=2)
     rc = 0 if verdict["pass"] else 1
     if args.report == "json":
         # CI convention: one machine-readable line, exit 0/1.
         lat = verdict["latency"]
-        print(json.dumps({
+        line = {
             "pass": verdict["pass"],
             "rate_target": verdict["rate_target"],
             "rate_achieved": verdict["rate_achieved"],
@@ -1030,7 +1064,14 @@ def cmd_soak(args) -> int:
             "survived": verdict["faults"]["survived"],
             "exactly_once": verdict["audit"]["exactly_once"],
             "divergences": len(verdict["audit"]["divergences"]),
-            "artifact": out_path}))
+            "artifact": out_path}
+        if "autoscale" in verdict:
+            asc = verdict["autoscale"]
+            line["autoscale_decisions"] = asc["decisions"]
+            line["autoscale_rescales"] = asc["autoscale_rescales"]
+            line["operator_rescale_events"] = \
+                asc["operator_rescale_events"]
+        print(json.dumps(line))
         return rc
     lat = verdict["latency"]
     print(f"soak {'PASS' if verdict['pass'] else 'FAIL'}: "
@@ -1047,6 +1088,15 @@ def cmd_soak(args) -> int:
     print(f"audit: exactly_once={a['exactly_once']} "
           f"({a['epochs_checked']} epochs checked, "
           f"{len(a['divergences'])} divergences)")
+    if "autoscale" in verdict:
+        asc = verdict["autoscale"]
+        print(f"autoscale: {asc['decisions']} decisions "
+              f"{asc['by_action']}; {asc['autoscale_rescales']} "
+              f"self-directed re-cuts, "
+              f"{asc['operator_rescale_events']} operator events; "
+              f"max {asc['max_actions_per_cooldown']} action(s) per "
+              f"{asc['cooldown_fences']}-fence cooldown; "
+              f"log {asc['log_digest']}")
     for d in a["divergences"]:
         print(f"  divergence: {d}")
     for w in verdict["windows"]:
@@ -1356,12 +1406,20 @@ def main(argv=None) -> int:
     pk.add_argument("--complete-every", type=int, default=2,
                     help="complete every Nth checkpoint (in-between "
                          "fences stay pending: checkpoint-under-load)")
+    pk.add_argument("--autoscale", action="store_true",
+                    help="close the loop: a deterministic policy "
+                         "engine samples the metric rollup at every "
+                         "completed fence and re-cuts the job itself "
+                         "(rescale_live) — decisions ride the SCALE "
+                         "determinant log so recovery replays them; "
+                         "the verdict lands in AUTOSCALE_r0N.json")
     pk.add_argument("--workdir", default=None,
                     help="checkpoint/lease dir (default: a fresh "
                          "tempdir)")
     pk.add_argument("--out", default=None, metavar="FILE",
                     help="verdict artifact path (default: next free "
-                         "SOAK_r0N.json in the cwd)")
+                         "SOAK_r0N.json in the cwd, AUTOSCALE_r0N."
+                         "json with --autoscale)")
     pk.add_argument("--report", choices=["json"], default=None,
                     help="machine-readable summary for CI: one JSON "
                          "line; exit 0 pass / 1 fail either way")
@@ -1446,7 +1504,7 @@ def main(argv=None) -> int:
                     metavar="NAME",
                     help="model to check: checkpoint, recovery, lease, "
                          "admission, repartition (repeatable; "
-                         "default: all five)")
+                         "default: all six)")
     pv.add_argument("--workers", type=int, default=2,
                     help="worker/contender count in the bound "
                          "(default 2)")

@@ -600,7 +600,8 @@ class ServeRouter:
 
     def _invalidate(self, i: int) -> None:
         with self._lock:
-            self._status[i] = None
+            if i < len(self._status):
+                self._status[i] = None
 
     # --- reads -----------------------------------------------------------
 
@@ -613,7 +614,10 @@ class ServeRouter:
             try:
                 out = self.replicas[i].query(vertex, key, state=state)
                 self.replica_reads += 1
-            except (QueryTimeoutError, QueryRejectedError, OSError):
+            except (QueryTimeoutError, QueryRejectedError, OSError,
+                    IndexError):
+                # IndexError: the tier shrank between routing and the
+                # call (drop_replica) — reroute like any replica loss
                 self._invalidate(i)
                 out = None
         if out is None:
@@ -653,7 +657,8 @@ class ServeRouter:
                     out = self.replicas[dest].query_batch(
                         vertex, sub_keys, state=state)
                     self.replica_reads += len(positions)
-                except (QueryTimeoutError, QueryRejectedError, OSError):
+                except (QueryTimeoutError, QueryRejectedError, OSError,
+                        IndexError):
                     self._invalidate(dest)
                     self.reroutes += len(positions)
                     out = None
@@ -683,6 +688,11 @@ class ServeTier:
                  timeout_s: float = 5.0):
         self.runner = runner
         self.vertex_id = int(vertex_id)
+        self.state_name = state
+        self.timeout_s = float(timeout_s)
+        #: monotone name counter — replica NAMES are never reused even
+        #: when an index is (drop then add), so logs stay unambiguous
+        self._n_created = 0
         self.owner_endpoint = None
         from clonos_tpu.runtime.query import (QueryableStateClient,
                                               QueryableStateEndpoint)
@@ -692,14 +702,8 @@ class ServeTier:
         self.replicas: List[ReadReplica] = []
         self.endpoints: List[ReplicaServeEndpoint] = []
         self.clients: List[ReplicaStateClient] = []
-        for i in range(n_replicas):
-            rep = ReadReplica(runner, vertex_id, state=state,
-                              name=f"replica-{i}")
-            ep = ReplicaServeEndpoint(rep)
-            self.replicas.append(rep)
-            self.endpoints.append(ep)
-            self.clients.append(ReplicaStateClient(
-                ep.address, timeout_s=timeout_s))
+        for _ in range(n_replicas):
+            self._build_replica()
         self.router = ServeRouter(
             self.owner_client, self.clients,
             num_key_groups=runner.job.num_key_groups,
@@ -709,6 +713,67 @@ class ServeTier:
         # last_sealed_epoch on the sequential path).
         runner.fence_hooks.append(self._on_fence)
         self._register_gauges()
+
+    def _build_replica(self):
+        """One replica + endpoint + client, appended to the tier's
+        parallel lists (NOT yet visible to the router)."""
+        rep = ReadReplica(self.runner, self.vertex_id,
+                          state=self.state_name,
+                          name=f"replica-{self._n_created}")
+        self._n_created += 1
+        ep = ReplicaServeEndpoint(rep)
+        self.replicas.append(rep)
+        self.endpoints.append(ep)
+        self.clients.append(ReplicaStateClient(
+            ep.address, timeout_s=self.timeout_s))
+        return rep
+
+    # --- runtime-adjustable replica count (the autoscaler's read-path
+    # --- scale knob; ROADMAP "replica count fixed at tier build")
+
+    def add_replica(self) -> int:
+        """Grow the read tier by one replica at runtime. The new
+        replica adopts ``standbys.latest`` immediately if one exists
+        and (re)fills at the next seal — the PR 14 revival path — so
+        it serves with honest staleness from the first read. The
+        router's ``kg % R`` assignment picks up the new count the
+        moment the replica is published under the router lock."""
+        i = len(self.replicas)
+        self._build_replica()
+        with self.router._lock:
+            self.router.replicas.append(self.clients[i])
+            self.router._status.append(None)
+            self.router._status_at.append(0.0)
+        g = self.runner.metrics.group("serve")
+        g.gauge(f"replica.{i}.staleness-epochs",
+                lambda i=i: self.replicas[i].staleness_epochs())
+        return i
+
+    def drop_replica(self) -> int:
+        """Shrink the read tier by one replica (the last index, so the
+        ``kg % R`` map and the dense gauge indexing both contract
+        cleanly). The router stops routing to it under the lock BEFORE
+        the endpoint closes — an in-flight read that already picked it
+        reroutes to the owner like any replica failure (staleness,
+        never an error). Its status cache entries drop with it and its
+        staleness gauge is unregistered (the registry would otherwise
+        pin the dead closure forever)."""
+        if len(self.replicas) <= 1:
+            raise ValueError("cannot drop the last read replica")
+        i = len(self.replicas) - 1
+        with self.router._lock:
+            self.router.replicas.pop()
+            self.router._status = [None] * len(self.router.replicas)
+            self.router._status_at = [0.0] * len(self.router.replicas)
+        client = self.clients.pop()
+        ep = self.endpoints.pop()
+        rep = self.replicas.pop()
+        client.close()
+        ep.close()
+        rep.close()
+        self.runner.metrics.unregister(
+            f"serve.replica.{i}.staleness-epochs")
+        return i
 
     def _on_fence(self, closed: int) -> None:
         # Fence hooks fire before the (possibly pipelined) seal lands;
@@ -730,9 +795,12 @@ class ServeTier:
         g.gauge("replicas-alive",
                 lambda: sum(1 for r in self.replicas if r.alive))
         self._meter = g.meter("reads-per-sec")
-        for i, rep in enumerate(self.replicas):
+        # index-based closures (not per-object): the gauge for slot i
+        # always reads the CURRENT occupant, so a drop-then-add cycle
+        # that reuses the index never serves a dead replica's numbers.
+        for i in range(len(self.replicas)):
             g.gauge(f"replica.{i}.staleness-epochs",
-                    lambda rep=rep: rep.staleness_epochs())
+                    lambda i=i: self.replicas[i].staleness_epochs())
 
     def mark_reads(self, n: int) -> None:
         self._meter.mark(n)

@@ -20,6 +20,7 @@ from .slo import (SLOSpec, SLOTracker, Window,  # noqa: F401
                   corrected_closed_loop, quantile)
 from .driver import (SoakConfig, SoakDriver, SoakHarness,  # noqa: F401
                      build_soak_fixture, default_kill_targets,
+                     next_autoscale_artifact_path,
                      next_rescale_artifact_path,
                      next_serve_artifact_path, next_soak_artifact_path)
 from .serveload import ServeLoad  # noqa: F401
@@ -30,4 +31,5 @@ __all__ = ["ChaosEvent", "ChaosSchedule", "parse_schedule",
            "SoakConfig", "SoakDriver", "SoakHarness",
            "build_soak_fixture", "default_kill_targets",
            "next_soak_artifact_path", "next_serve_artifact_path",
-           "next_rescale_artifact_path", "ServeLoad"]
+           "next_rescale_artifact_path",
+           "next_autoscale_artifact_path", "ServeLoad"]

@@ -22,6 +22,8 @@ Grammar (one event per line or ``;``-separated; ``#`` comments)::
                                       # re-route to the owner, no errors
     at 50s rescale 4                  # live re-cut: keyed vertices to
                                       # parallelism 4 at the next fence
+    at 55s load-spike 4x for 3s       # offered-rate multiplier over a
+                                      # window — the autoscaler's cue
 
 Durations accept ``ms``/``s`` suffixes (bare numbers are seconds).
 ``ChaosSchedule.seeded`` generates a schedule from a seed via a seeded
@@ -52,8 +54,14 @@ import numpy as np
 #: target parallelism (``ClusterRunner.rescale_live``) — exactly-once
 #: must hold across the handoff and the read tier re-homes. Target =
 #: the new keyed parallelism (exactly one positive integer).
+#: ``load-spike`` is not a fault at all but a LOAD event: the offered
+#: rate multiplies by ``factor`` for ``duration_s`` — the token bucket
+#: paces chunks closer together while record CONTENTS stay identical
+#: (logical time), so the fault-free control twin sees the exact same
+#: spike and the byte-exact audit diff keeps gating. It is the cue the
+#: autoscaler (clonos_tpu/autoscale/) is designed to answer.
 FAULT_KINDS = ("kill", "gray", "leader-loss", "stall", "nondet",
-               "backlog", "replica-kill", "rescale")
+               "backlog", "replica-kill", "rescale", "load-spike")
 
 
 def _dur(tok: str) -> float:
@@ -90,15 +98,19 @@ class ChaosEvent:
     duration_s: float = 0.0
     #: leader-loss: how long the rival holds the stolen lease
     hold_s: float = 0.0
+    #: load-spike: offered-rate multiplier over the window
+    factor: float = 0.0
 
     def to_text(self) -> str:
         parts = [f"at {_fmt_dur(self.at_s)}", self.kind]
         if self.targets:
             parts.append(",".join(str(t) for t in self.targets))
+        if self.kind == "load-spike":
+            parts.append(f"{self.factor:g}x")
         if self.kind in ("gray", "stall"):
             parts.append(f"delay={_fmt_dur(self.delay_s)}")
             parts.append(f"for {_fmt_dur(self.duration_s)}")
-        if self.kind == "backlog":
+        if self.kind in ("backlog", "load-spike"):
             parts.append(f"for {_fmt_dur(self.duration_s)}")
         if self.kind == "leader-loss" and self.hold_s:
             parts.append(f"hold={_fmt_dur(self.hold_s)}")
@@ -119,8 +131,23 @@ def _parse_event(line: str) -> ChaosEvent:
     delay_s = 0.0
     duration_s = 0.0
     hold_s = 0.0
+    factor = 0.0
     i = 3
-    if kind == "rescale":
+    if kind == "load-spike":
+        if i >= len(toks):
+            raise ValueError(f"chaos event {line!r}: load-spike needs "
+                             f"a rate multiplier (e.g. 4x)")
+        tok = toks[i]
+        try:
+            factor = float(tok[:-1] if tok.endswith("x") else tok)
+        except ValueError:
+            raise ValueError(f"chaos event {line!r}: bad multiplier "
+                             f"{tok!r} (want e.g. 4x)")
+        if factor <= 0:
+            raise ValueError(f"chaos event {line!r}: multiplier must "
+                             f"be positive")
+        i += 1
+    elif kind == "rescale":
         if i >= len(toks):
             raise ValueError(f"chaos event {line!r}: rescale needs the "
                              f"new keyed parallelism")
@@ -174,15 +201,15 @@ def _parse_event(line: str) -> ChaosEvent:
     if kind in ("gray", "stall") and (delay_s <= 0 or duration_s <= 0):
         raise ValueError(f"chaos event {line!r}: {kind} needs "
                          f"delay=<d> for <d>")
-    if kind == "backlog" and duration_s <= 0:
-        raise ValueError(f"chaos event {line!r}: backlog needs "
+    if kind in ("backlog", "load-spike") and duration_s <= 0:
+        raise ValueError(f"chaos event {line!r}: {kind} needs "
                          f"for <duration>")
     if kind == "gray" and len(targets) != 1:
         raise ValueError(f"chaos event {line!r}: gray takes exactly one "
                          f"target")
     return ChaosEvent(at_s=at_s, kind=kind, targets=targets,
                       delay_s=delay_s, duration_s=duration_s,
-                      hold_s=hold_s)
+                      hold_s=hold_s, factor=factor)
 
 
 def event_from_dict(d: dict) -> ChaosEvent:
@@ -197,7 +224,8 @@ def event_from_dict(d: dict) -> ChaosEvent:
         targets=tuple(int(t) for t in d.get("targets") or ()),
         delay_s=float(d.get("delay_s", 0.0)),
         duration_s=float(d.get("duration_s", 0.0)),
-        hold_s=float(d.get("hold_s", 0.0)))
+        hold_s=float(d.get("hold_s", 0.0)),
+        factor=float(d.get("factor", 0.0)))
 
 
 def read_trace_schedule(path: str) -> "ChaosSchedule":
@@ -318,6 +346,11 @@ class ChaosSchedule:
                 events.append(ChaosEvent(
                     float(at_s), "rescale",
                     targets=(int((2, 4)[int(rng.randint(2))]),)))
+            elif kind == "load-spike":
+                events.append(ChaosEvent(
+                    float(at_s), "load-spike",
+                    factor=float((2.0, 4.0)[int(rng.randint(2))]),
+                    duration_s=round(float(rng.uniform(1.0, 3.0)), 2)))
             else:                       # nondet
                 events.append(ChaosEvent(float(at_s), "nondet"))
         return cls(events)

@@ -34,6 +34,7 @@ from typing import Any, Dict, List, Optional
 import jax.numpy as jnp
 import numpy as np
 
+from clonos_tpu.autoscale import SignalAggregator
 from clonos_tpu.obs import get_tracer
 from clonos_tpu.obs.digest import diff_ledgers
 
@@ -45,6 +46,31 @@ from .slo import SLOSpec, SLOTracker, quantile
 #: counts, and ordering stay plausible) so every structural invariant
 #: passes and only the digest chain catches it.
 _NONDET_MULT, _NONDET_ADD, _NONDET_MOD = 31, 1009, 9973
+
+
+def _keyed_parallelism(runner) -> int:
+    """The re-cuttable cut: the keyed (interior) stages' parallelism —
+    the quantity ``rescale_live``'s target names. Source and sink keep
+    theirs across a re-cut, so only interior vertices count."""
+    job = runner.job
+    pars = [v.parallelism for v in job.vertices
+            if job.in_edges(v.vertex_id) and job.out_edges(v.vertex_id)]
+    if not pars:
+        pars = [v.parallelism for v in job.vertices]
+    return max(pars)
+
+
+def _max_actions_per_cooldown(records, cooldown: int) -> int:
+    """Worst-case count of SCALE ACTIONS (non-holds) inside any
+    ``cooldown``-fence window of the decision log — the verdict's
+    rate-limit witness (must be <= 1 when the cooldown held)."""
+    seqs = [r["decision"]["seq"] for r in records
+            if r["decision"]["action"] != "hold"]
+    best = 0
+    for i, s in enumerate(seqs):
+        n = sum(1 for t in seqs[i:] if t - s < cooldown)
+        best = max(best, n)
+    return best
 
 
 class SoakHarness:
@@ -100,6 +126,20 @@ class SoakHarness:
         #: per-event handoff stats for the verdict
         self.rescales = 0
         self.rescale_stats: List[Dict[str, Any]] = []
+        #: offered-load spike (the ``load-spike`` chaos event): the
+        #: token bucket's chunk period divides by this factor until the
+        #: expiry instant. Pacing ONLY — record contents are logical-
+        #: time-deterministic on both runners, so the fault-free
+        #: control twin experiences the identical spike and the ledger
+        #: diff keeps gating byte-exactly through it.
+        self.spike_factor = 1.0
+        self.spike_until = 0.0
+        #: self-directed re-cuts (autoscale/controller.py closing the
+        #: loop): counted apart from the operator ``rescale`` event —
+        #: the closed-loop acceptance bar is ZERO operator events with
+        #: the system re-cutting itself.
+        self.autoscale_rescales = 0
+        self.autoscale_stats: List[Dict[str, Any]] = []
 
     # --- fault application ---------------------------------------------------
 
@@ -204,6 +244,25 @@ class SoakHarness:
     def backlog_active(self, now_s: float) -> bool:
         return now_s < self.backlog_until
 
+    def _apply_load_spike(self, event: ChaosEvent,
+                          now_s: float) -> None:
+        # Not a fault in the cluster — a LOAD event: the open-loop
+        # client offers chunks factor-x faster for the window (the
+        # autoscaler's cue). Only wall-clock pacing changes; logical
+        # time keeps record contents identical on both runners, so the
+        # control twin's ledger stays byte-comparable through the
+        # spike and the exactly-once audit keeps gating.
+        self.spike_factor = max(self.spike_factor, event.factor)
+        self.spike_until = max(self.spike_until,
+                               now_s + event.duration_s)
+        self.tracer.event("soak.chaos.load-spike",
+                          factor=event.factor,
+                          until_s=round(self.spike_until, 3))
+
+    def rate_factor(self, now_s: float) -> float:
+        """Current offered-rate multiplier (1.0 outside a spike)."""
+        return self.spike_factor if now_s < self.spike_until else 1.0
+
     def _apply_replica_kill(self, event: ChaosEvent,
                             now_s: float) -> None:
         # Read-tier chaos: a serve replica dies mid-run. Degradation —
@@ -278,6 +337,57 @@ class SoakHarness:
                           drained=stats["drained_records"],
                           stall_ms=round(stall_ms, 1))
 
+    def autoscale_rescale(self, target: int) -> Dict[str, Any]:
+        """Execute an autoscaler-decided re-cut at the completed fence
+        the driver just drained — the exact fence → drain → migrate →
+        redirect path the operator ``rescale`` event takes (control
+        twin re-cut identically at the SAME fence, serve tier re-homed)
+        but charged to the AUTOSCALE ledger, not the fault counters:
+        the closed-loop acceptance bar is zero operator events."""
+        target = int(target)
+        rescale = getattr(self.runner, "_soak_rescaler", None)
+        if rescale is None:
+            raise RuntimeError(
+                "autoscale re-cut requested but the runner has no "
+                "rescaler attached (build_soak_fixture arms one)")
+        if self._stall_orig is not None:
+            # same rule as the operator path: an active storage stall
+            # dies with the old incarnation
+            self.runner.coordinator.storage.write = self._stall_orig
+            self._stall_orig = None
+            for st in self.runner.executor._tier_stores():
+                st.write_delay_s = 0.0
+            self._stall_until = 0.0
+        t0 = _time.monotonic()
+        self.runner, stats = rescale(target)
+        stall_ms = (_time.monotonic() - t0) * 1e3
+        c = self.control
+        if c is not None:
+            while c.executor.epoch_id < stats["from_epoch"]:
+                c.run_epoch(complete_checkpoint=True)
+            c.drain_fence()
+            self.control, _ = c._soak_rescaler(target)
+        if self.serve_tier is not None:
+            self.serve_tier.rehome(self.runner)
+        self.autoscale_rescales += 1
+        self.autoscale_stats.append({
+            "target": target,
+            "fence_checkpoint": stats["fence_checkpoint"],
+            "groups": stats["groups"],
+            "drained_records": stats["drained_records"],
+            "moved_key_groups": stats["moved_key_groups"],
+            "fence_stall_ms": round(stall_ms, 1),
+        })
+        # the fence stall is still an outage the open-loop client saw
+        self.recoveries_ms.append(stall_ms)
+        # re-validate exactly-once at the next fence, like any re-cut
+        self.audit_pending = True
+        self.tracer.event("soak.autoscale.rescaled", target=target,
+                          fence_checkpoint=stats["fence_checkpoint"],
+                          drained=stats["drained_records"],
+                          stall_ms=round(stall_ms, 1))
+        return stats
+
     def _apply_nondet(self, event: ChaosEvent, now_s: float) -> None:
         # Unlogged value perturbation on-device (audit bait): occupied
         # in-flight ring slots get salted values. Counts, keys, and
@@ -316,6 +426,11 @@ class SoakHarness:
             self.backlog_until = 0.0
             self.faults_survived += 1
             self.tracer.event("soak.chaos.expired", kind="backlog")
+        if self.spike_until and now_s >= self.spike_until:
+            self.spike_until = 0.0
+            self.spike_factor = 1.0
+            self.faults_survived += 1
+            self.tracer.event("soak.chaos.expired", kind="load-spike")
 
     def audit_check(self) -> List[str]:
         """Advance the control twin to the soak runner's last sealed
@@ -366,7 +481,7 @@ class SoakDriver:
                  spec: Optional[SLOSpec] = None,
                  control=None, election=None,
                  records_per_step: Optional[int] = None,
-                 read_load=None):
+                 read_load=None, autoscaler=None):
         self.runner = runner
         self.cfg = config
         self.schedule = schedule if schedule is not None \
@@ -388,12 +503,37 @@ class SoakDriver:
         self._rate_now = 0.0
         self._backlog_chunks = 0
         self._truncated = False
+        self._soak_now = 0.0
+        #: closed-loop policy engine (autoscale.AutoscaleController):
+        #: when attached, the driver samples ScaleSignals at every
+        #: completed+drained fence and lets the controller decide and
+        #: execute — worker re-cuts ride harness.autoscale_rescale
+        #: (zero operator events), replica moves ride the serve tier.
+        self.autoscaler = autoscaler
+        self._signals = None
+        if autoscaler is not None:
+            self._signals = SignalAggregator()
+            tier = self.harness.serve_tier
+            autoscaler.bind(
+                execute_workers=self.harness.autoscale_rescale,
+                add_replica=(tier.add_replica if tier is not None
+                             else None),
+                drop_replica=(tier.drop_replica if tier is not None
+                              else None),
+                healthy=lambda: (
+                    not self.runner.heartbeats.expired()
+                    and not self.runner.fence_tail_in_flight()))
         self._register_gauges()
 
     def _register_gauges(self) -> None:
         g = self.runner.metrics.group("soak")
         cfg, h, slo = self.cfg, self.harness, self.slo
         g.gauge("target-rate", lambda: cfg.rate)
+        # what the open-loop client is CURRENTLY offering: the base
+        # rate times any live load-spike factor — the signal plane's
+        # numerator (autoscale/signals.py reads it by suffix).
+        g.gauge("offered-rate", lambda: round(
+            cfg.rate * h.rate_factor(self._soak_now), 1))
         g.gauge("rate", lambda: round(self._rate_now, 1))
         g.gauge("backlog-chunks", lambda: self._backlog_chunks)
         g.gauge("windows-breached",
@@ -407,6 +547,15 @@ class SoakDriver:
         g.gauge("rescales", lambda: h.rescales)
         g.gauge("degraded-workers", lambda: len(
             self.runner.heartbeats.degraded(cfg.degraded_grace_s)))
+        if self.autoscaler is not None:
+            # autoscale.* rides the same rollup — re-registered (like
+            # soak.*) on the NEW incarnation's registry after a re-cut
+            self.autoscaler.register_gauges(
+                self.runner.metrics,
+                actual_workers=lambda: _keyed_parallelism(self.runner),
+                actual_replicas=lambda: (
+                    len(self.read_load.tier.replicas)
+                    if self.read_load is not None else 0))
 
     # --- leadership gate -----------------------------------------------------
 
@@ -433,6 +582,33 @@ class SoakDriver:
         self.slo.observe_recovery(soak_now, ms)
         self.tracer.event("soak.leader.reacquired",
                           pause_ms=round(ms, 1))
+
+    # --- the closed loop -----------------------------------------------------
+
+    def _autoscale_fence(self, r, ex, now_s: float):
+        """One autoscaler evaluation at a completed+drained fence:
+        sample :class:`ScaleSignals` off the metric rollup, let the
+        controller decide (the decision and its snapshot land in the
+        SCALE determinant log regardless of outcome) and execute. An
+        executed worker re-cut swaps the runner incarnation underneath
+        us — rebind every live handle and re-register the gauges,
+        exactly like the operator ``rescale`` path. Returns the
+        (possibly new) ``(runner, executor)`` pair."""
+        h = self.harness
+        sigs = self._signals.sample_from(
+            r.metrics.snapshot(), epoch=ex.epoch_id,
+            workers=_keyed_parallelism(r),
+            failed_subtasks=len(r.heartbeats.expired()),
+            unfenced=r.fence_tail_in_flight())
+        decision, executed = self.autoscaler.on_fence(ex.epoch_id, sigs)
+        if executed is not None and h.runner is not r:
+            # a worker re-cut ran: the fence stall is an outage the
+            # paced load paid — charge it like any recovery window
+            self.slo.observe_recovery(now_s, h.recoveries_ms[-1])
+            r = self.runner = h.runner
+            ex = r.executor
+            self._register_gauges()
+        return r, ex
 
     # --- the paced loop ------------------------------------------------------
 
@@ -482,9 +658,14 @@ class SoakDriver:
         sent_chunks = 0
         sent_records = 0
         t0 = _time.monotonic()
+        # accumulating token bucket: ``intended_s`` is the instant the
+        # NEXT chunk is due. A live load-spike divides the period, so
+        # the offered schedule genuinely accelerates mid-run (and the
+        # corrected latency of every queued chunk is charged against
+        # the spiked schedule, open-loop style).
+        intended_s = 0.0
 
         while True:
-            intended_s = sent_chunks * period_s
             if intended_s >= cfg.duration_s:
                 break
             if ex.epoch_id >= max_epochs - 2:
@@ -530,6 +711,7 @@ class SoakDriver:
                 _time.sleep(h.gray_delay_s)
             done_wall = _time.monotonic()
             now_s = done_wall - t0
+            self._soak_now = now_s
             sent_chunks += 1
             sent_records += chunk_records
             self._rate_now = sent_records / max(now_s, 1e-9)
@@ -537,6 +719,10 @@ class SoakDriver:
                              corrected_ms=(now_s - intended_s) * 1e3,
                              actual_ms=(done_wall - send_wall) * 1e3,
                              records=chunk_records)
+            # advance the bucket by one (possibly spiked) period — the
+            # factor at the chunk's wall instant, so a spike window on
+            # the soak clock accelerates exactly the chunks inside it
+            intended_s += period_s / h.rate_factor(now_s)
             # -- read load rides the same clock: each ingest chunk is
             # chased by a burst of routed reads, so read latency and
             # staleness are measured UNDER concurrent ingest, and a
@@ -590,6 +776,7 @@ class SoakDriver:
                     r.coordinator.discard_pending_through(
                         ex.epoch_id - 1)
                 if complete:
+                    fence_drained = False
                     if pending_kills and r.fence_tail_in_flight():
                         # kill MID-fence-tail: abandon only the OLDER
                         # skipped checkpoints (sparing the in-flight
@@ -620,6 +807,7 @@ class SoakDriver:
                         r.drain_fence()
                         r.coordinator.discard_pending_through(
                             ex.epoch_id - 1)
+                        fence_drained = True
                     force_complete = False
                     kill_armed = bool(pending_kills)
                     if pending_rescales:
@@ -640,6 +828,13 @@ class SoakDriver:
                         r = self.runner = h.runner
                         ex = r.executor
                         self._register_gauges()
+                    if self.autoscaler is not None and fence_drained:
+                        # the closed loop: signals sampled off the
+                        # metric rollup at THIS completed+drained
+                        # fence, policy decides, and a scale action
+                        # executes here — the only place a self-
+                        # directed re-cut is allowed to happen
+                        r, ex = self._autoscale_fence(r, ex, now_s)
                 if h.audit_pending:
                     # the fence worker may be mid seal -> ledger
                     # append; diffing now would report a false
@@ -772,6 +967,36 @@ class SoakDriver:
             # contended with (the honest-measurement requirement).
             out["serve"] = self.read_load.summary()
             out["serve"]["replica_kills"] = h.replica_kills
+        if self.autoscaler is not None:
+            # Closed-loop verdict: every decision is in the SCALE log
+            # (digest pins the byte encoding), scale actions are rate-
+            # limited by the cooldown (max_actions_per_cooldown must be
+            # <= 1 for a well-behaved policy), and the self-directed
+            # re-cuts are itemized apart from operator events — the
+            # acceptance bar is operator_rescale_events == 0 with
+            # autoscale_rescales > 0 under a load spike.
+            a = self.autoscaler
+            by_action: Dict[str, int] = {}
+            for rec in a.log.records:
+                act = rec["decision"]["action"]
+                by_action[act] = by_action.get(act, 0) + 1
+            out["autoscale"] = {
+                "decisions": len(a.log),
+                "by_action": dict(sorted(by_action.items())),
+                "rescales_executed": a.rescales_executed,
+                "replicas_added": a.replicas_added,
+                "replicas_dropped": a.replicas_dropped,
+                "refusals": a.refusals,
+                "replayed_decisions": a.replayed_decisions,
+                "max_actions_per_cooldown": _max_actions_per_cooldown(
+                    a.log.records, a.policy.cfg.cooldown_fences),
+                "cooldown_fences": a.policy.cfg.cooldown_fences,
+                "operator_rescale_events": h.rescales,
+                "autoscale_rescales": h.autoscale_rescales,
+                "rescale_stats": list(h.autoscale_stats),
+                "log_digest": a.log.digest(),
+                "log_path": a.log.path,
+            }
         # The FT call-site population this run exercised
         # (analysis/census.py): SOAK_r0N.json numbers stay traceable
         # to the exact source shape that produced them.
@@ -820,6 +1045,17 @@ def next_rescale_artifact_path(root: Optional[str] = None) -> str:
     while os.path.exists(os.path.join(root, f"RESCALE_r{n:02d}.json")):
         n += 1
     return os.path.join(root, f"RESCALE_r{n:02d}.json")
+
+
+def next_autoscale_artifact_path(root: Optional[str] = None) -> str:
+    """Next free ``AUTOSCALE_r0N.json`` slot (the ``soak --autoscale``
+    closed-loop verdict artifact, sibling of SOAK/BENCH/SERVE)."""
+    root = root or os.getcwd()
+    n = 1
+    while os.path.exists(os.path.join(root,
+                                      f"AUTOSCALE_r{n:02d}.json")):
+        n += 1
+    return os.path.join(root, f"AUTOSCALE_r{n:02d}.json")
 
 
 def build_soak_fixture(workdir: str, rate: float, duration_s: float,

@@ -131,6 +131,9 @@ class MetricGroup:
     def histogram(self, name: str) -> Histogram:
         return self._registry._register(f"{self.scope}.{name}", Histogram())
 
+    def remove(self, name: str) -> bool:
+        return self._registry.unregister(f"{self.scope}.{name}")
+
     def add_group(self, name: str) -> "MetricGroup":
         return MetricGroup(self._registry, f"{self.scope}.{name}")
 
@@ -153,6 +156,15 @@ class MetricRegistry:
                 return existing
             self._metrics[full_name] = metric
             return metric
+
+    def unregister(self, full_name: str) -> bool:
+        """Drop a metric so its name can be re-registered fresh.
+        ``_register`` dedupes by full name and returns the EXISTING
+        metric — a dynamically retired component (e.g. a dropped read
+        replica) must unregister, or a later same-named registration
+        silently keeps the dead closure."""
+        with self._lock:
+            return self._metrics.pop(full_name, None) is not None
 
     def add_reporter(self, reporter: "Reporter") -> None:
         self._reporters.append(reporter)

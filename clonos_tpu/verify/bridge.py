@@ -33,7 +33,7 @@ from clonos_tpu.soak.chaos import ChaosEvent, ChaosSchedule
 from clonos_tpu.verify.explorer import Violation
 
 #: ChaosEvent field defaults the hints may override
-_EVENT_FIELDS = ("targets", "delay_s", "duration_s", "hold_s")
+_EVENT_FIELDS = ("targets", "delay_s", "duration_s", "hold_s", "factor")
 
 
 def event_for(action, at_s: float) -> Optional[ChaosEvent]:
@@ -77,7 +77,8 @@ def trace_records(violation: Violation, start_s: float = 0.5,
                             "targets": list(ev.targets),
                             "delay_s": ev.delay_s,
                             "duration_s": ev.duration_s,
-                            "hold_s": ev.hold_s}
+                            "hold_s": ev.hold_s,
+                            "factor": ev.factor}
             at += spacing_s
         out.append(rec)
     return out

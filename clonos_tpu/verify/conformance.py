@@ -31,7 +31,8 @@ from clonos_tpu.verify.explorer import Action, traces
 from clonos_tpu.verify.models import (FSM_NAMES, PHASE_NAMES,
                                       AdmissionModel, CheckpointModel,
                                       LeaseModel, RecoveryModel,
-                                      RepartitionModel)
+                                      RepartitionModel,
+                                      ScalePolicyModel)
 
 
 @dataclasses.dataclass
@@ -568,17 +569,151 @@ def conform_repartition(n_traces: int = 3, workers: int = 2,
     return _replay("repartition", model, model_traces, Adapter)
 
 
+def conform_scalepolicy(n_traces: int = 3, workers: int = 2,
+                        epochs: int = 2, faults: int = 1,
+                        depth: int = 40) -> ConformanceReport:
+    """Replay ScalePolicyModel traces through the REAL
+    ``AutoscaleController`` (autoscale/controller.py) over the real
+    ``ScalePolicy``, configured to the model's bounds (sustain 1,
+    cooldown 2, one step of worker headroom, replica arms pinned
+    shut). Model load levels become concrete snapshots via
+    ``signals_for_level``; the controller's transition observers must
+    emit exactly the model's observe/fence/decide/log/execute stream,
+    and its PolicyState/decision-log projection must track the model
+    state step for step."""
+    from clonos_tpu.autoscale import (AutoscaleController, PolicyConfig,
+                                      ScalePolicy, signals_for_level)
+
+    model = ScalePolicyModel(workers=workers, epochs=epochs,
+                             faults=faults)
+    _LOAD = {0: 0.4, 1: 1.0, 2: 1.6}
+
+    class Adapter:
+        def __init__(self):
+            cfg = PolicyConfig(sustain_fences=model.sustain,
+                               cooldown_fences=model.cooldown,
+                               min_workers=model.min_w,
+                               max_workers=model.max_w,
+                               min_replicas=1, max_replicas=1)
+            self.workers = model.start_w
+            self.failed = 0
+            self.ac = AutoscaleController(
+                ScalePolicy(cfg),
+                execute_workers=self._exec_workers,
+                healthy=lambda: self.failed == 0)
+            self.ac.transition_observers.append(self._on)
+            self.obs: List[Tuple] = []
+
+        def _exec_workers(self, target):
+            self.workers = target
+
+        def _on(self, kind, **fields):
+            if kind == "observe":
+                self.obs.append((kind, fields["load"]))
+            elif kind == "fence":
+                self.obs.append((kind, fields["epoch"]))
+            elif kind == "decide":
+                self.obs.append((kind, fields["action"]))
+            elif kind == "log":
+                self.obs.append((kind, fields["seq"]))
+            elif kind == "execute":
+                self.obs.append((kind, fields["action"],
+                                 fields["target"]))
+            else:
+                self.obs.append((kind,))
+
+        def _model_decision(self, state):
+            """The model's decide outcome, recomputed from its
+            pre-decide state (mirrors ScalePolicyModel.apply)."""
+            (_ph, _fence, level, over, under, cd, w,
+             failed, _fl, _pend, _ld, _le, n_dec) = state
+            over2 = over + 1 if level == 2 else 0
+            under2 = under + 1 if level == 0 else 0
+            cd_gate = max(0, cd - 1)
+            dec = "hold"
+            if failed == 0 and cd_gate == 0:
+                if over2 >= model.sustain and w < model.max_w:
+                    dec = "up"
+                elif under2 >= model.sustain and w > model.min_w:
+                    dec = "down"
+            action = "hold" if dec == "hold" else "scale-workers"
+            return dec, action, n_dec + 1
+
+        def expected(self, state, action: Action):
+            k = action.kind
+            if k == "signal":
+                return [("observe", _LOAD[action.args[0]])]
+            if k == "fence":
+                return [("fence", state[1] + 1)]
+            if k == "decide":
+                _dec, act, seq = self._model_decision(state)
+                return [("decide", act), ("log", seq)]
+            if k == "execute":
+                direction, _fdec, _logged = state[9]
+                return [("execute", "scale-workers",
+                         state[6] + direction)]
+            if k in ("kill", "recover"):
+                return []        # the controller sees nothing yet
+            raise ValueError(f"unmapped scalepolicy action {action}")
+
+        def apply(self, state, action: Action):
+            self.obs = []
+            k = action.kind
+            if k == "signal":
+                # the snapshot carries the fence it will decide for
+                # and the health the controller observed
+                self.ac.observe(signals_for_level(
+                    action.args[0], epoch=state[1],
+                    workers=self.workers,
+                    failed_subtasks=self.failed))
+            elif k == "fence":
+                self.ac.note_fence(state[1] + 1)
+            elif k == "decide":
+                self.ac.decide()
+            elif k == "execute":
+                self.ac.execute()
+            elif k == "kill":
+                self.failed = 1
+            elif k == "recover":
+                self.failed = 0
+            return list(self.obs)
+
+        def projection_drift(self, state):
+            (_ph, _fence, _level, over, under, cd, w,
+             _failed, _fl, pend, _ld, _le, n_dec) = state
+            st = self.ac.state
+            if st.cooldown != cd:
+                return (f"cooldown={cd}", f"cooldown={st.cooldown}")
+            if (st.over_streak, st.under_streak) != (over, under):
+                return (f"streaks=({over},{under})",
+                        f"streaks=({st.over_streak},"
+                        f"{st.under_streak})")
+            if st.seq != n_dec or len(self.ac.log) != n_dec:
+                return (f"decisions={n_dec}",
+                        f"seq={st.seq} log={len(self.ac.log)}")
+            if self.workers != w:
+                return (f"workers={w}", f"workers={self.workers}")
+            if (self.ac.pending is not None) != (pend is not None):
+                return (f"pending={pend is not None}",
+                        f"pending={self.ac.pending is not None}")
+            return None
+
+    model_traces = traces(model, n_traces, depth=depth)
+    return _replay("scalepolicy", model, model_traces, Adapter)
+
+
 def run_conformance(components: Optional[List[str]] = None,
                     n_traces: int = 3, workers: int = 2,
                     epochs: int = 2, faults: int = 1,
                     workdir: Optional[str] = None
                     ) -> Dict[str, ConformanceReport]:
-    """Conformance for the requested components (default: all five).
+    """Conformance for the requested components (default: all six).
     ``workdir`` hosts the lease claim files (a temp dir is created
     when omitted)."""
     import tempfile
     components = list(components or ("checkpoint", "recovery", "lease",
-                                     "admission", "repartition"))
+                                     "admission", "repartition",
+                                     "scalepolicy"))
     out: Dict[str, ConformanceReport] = {}
     for c in components:
         if c == "checkpoint":
@@ -595,6 +730,9 @@ def run_conformance(components: Optional[List[str]] = None,
         elif c == "repartition":
             out[c] = conform_repartition(n_traces, workers=workers,
                                          epochs=epochs)
+        elif c == "scalepolicy":
+            out[c] = conform_scalepolicy(n_traces, workers=workers,
+                                         epochs=epochs, faults=faults)
         else:
             raise ValueError(f"unknown component {c!r}")
     return out

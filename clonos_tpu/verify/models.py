@@ -1,4 +1,4 @@
-"""Formal transition models of the five runtime protocols.
+"""Formal transition models of the six runtime protocols.
 
 Each model mirrors ONE real component's protocol — the transitions the
 implementation exposes to its driver — at the smallest state that
@@ -25,6 +25,13 @@ preserves the safety argument:
   records into its state, migrates the drained groups to the N±k
   incarnation, and only then redirects traffic — exactly once per
   record across the fence.
+- :class:`ScalePolicyModel` — the autoscaler's decision protocol
+  (autoscale/controller.py's ``AutoscaleController`` over the pure
+  ``ScalePolicy``): per completed fence, fold the load signal into
+  sustain streaks, decide under hysteresis + cooldown, LOG the
+  decision as a SCALE determinant, then execute the re-cut only if
+  the cluster is still healthy — under worker kills landing anywhere,
+  including between decide and execute.
 
 ``bug=`` injects a named, intentional protocol defect (see ``BUGS``).
 Each seeded bug reproduces a hazard the real protocol's discipline
@@ -82,6 +89,20 @@ BUGS: Dict[str, Dict[str, str]] = {
         "stale-writer": "the old incarnation keeps applying to a "
                         "group it already handed off — the new owner "
                         "replays the same records (duplicates)",
+    },
+    "scalepolicy": {
+        "no-cooldown": "decisions skip the cooldown gate — a sustained "
+                       "spike followed by its own backpressure dip "
+                       "thrashes the cluster up-then-down inside one "
+                       "cooldown window",
+        "unlogged-decision": "a scale action executes without its "
+                             "SCALE determinant — a recovered "
+                             "controller cannot replay it and would "
+                             "re-decide (double re-cut)",
+        "rescale-mid-recovery": "execute skips the health re-check — a "
+                                "worker kill landing between decide "
+                                "and execute lets a re-cut run over an "
+                                "in-progress recovery",
     },
 }
 
@@ -873,6 +894,227 @@ class RepartitionModel(Model):
         return None
 
 
+# --- autoscale policy ------------------------------------------------------
+
+# decision phases (the controller's observe → fence → decide cycle)
+_AS_IDLE = 0       # awaiting this fence's signal snapshot
+_AS_SIGNALED = 1   # snapshot taken, awaiting the fence completion
+_AS_FENCED = 2     # fence completed+drained, awaiting the decision
+_AS_PHASES = ("idle", "signaled", "fenced")
+
+
+class ScalePolicyModel(Model):
+    """The autoscaler's decision protocol (autoscale/policy.py +
+    autoscale/controller.py), at abstract load levels.
+
+    Per fence the controller observes one load level (0 low / 1 steady
+    / 2 high), completes the fence, and decides: fold the level into
+    sustain streaks, then — healthy and out of cooldown — scale up on
+    a sustained high, down on a sustained low, bounded to ±1 within
+    [min, max] workers. A scale decision is LOGGED as a SCALE
+    determinant when made and sits pending until ``execute`` carries
+    it out; worker kills land anywhere the controller is idle,
+    INCLUDING between decide and execute — the window the execute-time
+    health re-check exists for.
+
+    State::
+
+        (phase, fence, level, over, under, cooldown, workers,
+         failed, faults_left, pending, last_dec, last_execs, n_dec)
+
+    ``pending`` is ``(dir, fence_decided, logged)`` or None;
+    ``last_dec`` records the newest decision as ``(action, over,
+    under, cooldown_gate, healthy, room_up, room_down)`` — invariants
+    judge each decision the moment it is made, so only the newest need
+    be carried; ``last_execs`` keeps the two newest executed actions
+    ``(fence, dir, healthy, logged)`` (oscillation is a property of
+    consecutive pairs). ``n_dec`` counts decisions for the liveness
+    check (every completed fence must have produced exactly one).
+
+    Invariants:
+
+    - **no-thrash** — consecutive executed actions in OPPOSITE
+      directions are at least one full cooldown window apart.
+    - **decision-logged** — nothing executes without its SCALE
+      determinant (the replay-not-re-decide recovery contract).
+    - **no-rescale-mid-recovery** — nothing executes while a subtask
+      is failed (``rescale_live`` would be re-cutting a cluster that
+      is mid-recovery).
+    - **monotone-in-sustained-signals** — a healthy, out-of-cooldown
+      controller facing a sustained high MUST scale up (and never
+      down); facing a sustained low with headroom it MUST scale down.
+      Sustained pressure cannot be ignored or inverted.
+    """
+
+    name = "scalepolicy"
+
+    def __init__(self, workers: int = 2, epochs: int = 2,
+                 faults: int = 1, bug: Optional[str] = None):
+        self.min_w = 1
+        self.max_w = int(workers) + 1     # headroom for one scale-up
+        self.start_w = int(workers)
+        self.fences = int(epochs) + 2     # decision rounds
+        self.sustain = 1                  # fences a signal must hold
+        self.cooldown = 2                 # fences between actions
+        self.faults = int(faults)
+        self.bug = _check_bug("scalepolicy", bug)
+
+    def initial_state(self):
+        return (_AS_IDLE, 0, -1, 0, 0, 0, self.start_w,
+                0, self.faults, None, None, (), 0)
+
+    def enabled(self, state) -> List[Action]:
+        (phase, fence, _level, _over, _under, _cd, _w,
+         failed, faults_left, pending, _ld, _le, _nd) = state
+        acts: List[Action] = []
+        if phase == _AS_IDLE and pending is None and fence < self.fences:
+            # a 4x offered-rate spike is the live analog of sustained
+            # high load — the bridge compiles exactly this hint
+            acts.append(Action("signal", (2,),
+                               chaos=("load-spike",
+                                      (("factor", 4.0),
+                                       ("duration_s", 2.0)))))
+            acts.append(Action("signal", (1,)))
+            acts.append(Action("signal", (0,)))
+        if phase == _AS_SIGNALED:
+            acts.append(Action("fence"))
+        if phase == _AS_FENCED:
+            acts.append(Action("decide"))
+        if (phase == _AS_IDLE and pending is not None
+                and (failed == 0 or self.bug == "rescale-mid-recovery")):
+            acts.append(Action("execute"))
+        # kills and recoveries land only while the controller is idle:
+        # the signal→fence→decide triplet is atomic with respect to
+        # health — the controller decides on the snapshot it OBSERVED,
+        # so a health flip inside the triplet has no decision analog.
+        # The decide→execute window stays open (that interleaving is
+        # the rescale-mid-recovery hazard).
+        if phase == _AS_IDLE and failed == 0 and faults_left > 0:
+            acts.append(Action("kill",
+                               chaos=("kill", (("targets", (1,)),))))
+        if phase == _AS_IDLE and failed > 0:
+            acts.append(Action("recover"))
+        return acts
+
+    def apply(self, state, action: Action):
+        (phase, fence, level, over, under, cd, w,
+         failed, faults_left, pending, last_dec, last_execs,
+         n_dec) = state
+        k = action.kind
+        if k == "signal":
+            return (_AS_SIGNALED, fence, action.args[0], over, under,
+                    cd, w, failed, faults_left, pending, last_dec,
+                    last_execs, n_dec)
+        if k == "fence":
+            return (_AS_FENCED, fence + 1, level, over, under, cd, w,
+                    failed, faults_left, pending, last_dec, last_execs,
+                    n_dec)
+        if k == "decide":
+            over2 = over + 1 if level == 2 else 0
+            under2 = under + 1 if level == 0 else 0
+            cd_gate = max(0, cd - 1)
+            healthy = failed == 0
+            room_up = w < self.max_w
+            room_down = w > self.min_w
+            dec = "hold"
+            if healthy and (cd_gate == 0 or self.bug == "no-cooldown"):
+                if over2 >= self.sustain and room_up:
+                    dec = "up"
+                elif under2 >= self.sustain and room_down:
+                    dec = "down"
+            last_dec = (dec, over2, under2, cd_gate, healthy,
+                        room_up, room_down)
+            cd2, pend = cd_gate, pending
+            if dec != "hold":
+                logged = self.bug != "unlogged-decision"
+                pend = (1 if dec == "up" else -1, fence, logged)
+                cd2 = self.cooldown        # restart the cooldown clock
+                over2 = under2 = 0         # post-action: a new trend
+            return (_AS_IDLE, fence, -1, over2, under2, cd2, w,
+                    failed, faults_left, pend, last_dec, last_execs,
+                    n_dec + 1)
+        if k == "execute":
+            direction, _fdec, logged = pending
+            entry = (fence, direction, failed == 0, logged)
+            return (phase, fence, level, over, under, cd,
+                    w + direction, failed, faults_left, None, last_dec,
+                    (last_execs + (entry,))[-2:], n_dec)
+        if k == "kill":
+            return (phase, fence, level, over, under, cd, w, 1,
+                    faults_left - 1, pending, last_dec, last_execs,
+                    n_dec)
+        if k == "recover":
+            return (phase, fence, level, over, under, cd, w, 0,
+                    faults_left, pending, last_dec, last_execs, n_dec)
+        raise ValueError(f"unknown action {action}")
+
+    def invariants(self):
+        def no_thrash(state):
+            execs = state[11]
+            if len(execs) == 2:
+                (f1, d1, _h1, _l1), (f2, d2, _h2, _l2) = execs
+                if d1 != d2 and f2 - f1 < self.cooldown:
+                    return (f"opposite re-cuts {d1:+d} then {d2:+d} "
+                            f"only {f2 - f1} fence(s) apart (cooldown "
+                            f"window is {self.cooldown})")
+            return None
+
+        def logged(state):
+            execs = state[11]
+            for f, d, _h, lg in execs:
+                if not lg:
+                    return (f"re-cut {d:+d} at fence {f} executed "
+                            f"without its SCALE determinant")
+            return None
+
+        def healthy_exec(state):
+            execs = state[11]
+            for f, d, h, _lg in execs:
+                if not h:
+                    return (f"re-cut {d:+d} at fence {f} executed "
+                            f"over a failed subtask (mid-recovery)")
+            return None
+
+        def monotone(state):
+            last_dec = state[10]
+            if last_dec is None:
+                return None
+            dec, ov, un, cd_gate, healthy, room_up, room_down = last_dec
+            if not healthy or cd_gate > 0:
+                return None
+            if ov >= self.sustain and dec == "down":
+                return (f"sustained high load ({ov} fence(s)) answered "
+                        f"with a scale-DOWN")
+            if ov >= self.sustain and room_up and dec != "up":
+                return (f"sustained high load ({ov} fence(s)), healthy "
+                        f"and out of cooldown with headroom, but "
+                        f"decision was {dec!r}")
+            if (ov < self.sustain and un >= self.sustain and room_down
+                    and dec != "down"):
+                return (f"sustained low load ({un} fence(s)), healthy "
+                        f"and out of cooldown with floor room, but "
+                        f"decision was {dec!r}")
+            return None
+
+        return [("no-thrash", no_thrash),
+                ("decision-logged", logged),
+                ("no-rescale-mid-recovery", healthy_exec),
+                ("monotone-in-sustained-signals", monotone)]
+
+    def settled(self, state) -> Optional[str]:
+        (_phase, fence, _level, _over, _under, _cd, _w,
+         _failed, _faults_left, pending, _ld, _le, n_dec) = state
+        if fence < self.fences:
+            return (f"controller wedged after fence {fence} of "
+                    f"{self.fences}")
+        if n_dec != self.fences:
+            return (f"{self.fences} fence(s) completed but only "
+                    f"{n_dec} decision(s) made")
+        if pending is not None:
+            return "a logged scale decision was never executed"
+        return None
+
+
 #: registry: CLI/runner model names -> constructor
 MODELS = {
     "checkpoint": CheckpointModel,
@@ -880,4 +1122,5 @@ MODELS = {
     "lease": LeaseModel,
     "admission": AdmissionModel,
     "repartition": RepartitionModel,
+    "scalepolicy": ScalePolicyModel,
 }

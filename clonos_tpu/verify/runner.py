@@ -75,7 +75,7 @@ def run_verify(models: Optional[Sequence[str]] = None,
                bugs: Optional[Dict[str, str]] = None,
                conformance: bool = False,
                conformance_traces: int = 3) -> VerifyResult:
-    """Check the requested models (default: all five) at one bound.
+    """Check the requested models (default: all six) at one bound.
 
     ``quick`` swaps in :data:`QUICK_BOUND` wholesale. ``bugs`` maps a
     model name to a seeded defect from :data:`models.BUGS` — the

@@ -1,10 +1,10 @@
 """Protocol model checker (clonos_tpu/verify/): exhaustive exploration
 of the checkpoint / recovery / lease-fencing / admission / repartition
-transition models, seeded-bug counterexamples, the counterexample→chaos
-bridge, and the conformance layer that replays model traces against the
-real components.
+/ scale-policy transition models, seeded-bug counterexamples, the
+counterexample→chaos bridge, and the conformance layer that replays
+model traces against the real components.
 
-The acceptance spine: (1) all five models are violation-free at the
+The acceptance spine: (1) all six models are violation-free at the
 default bound; (2) every seeded bug in verify/models.py BUGS yields a
 MINIMAL counterexample (the invariants are not vacuous); (3) a
 counterexample round-trips through the chaos DSL byte-for-byte and —
@@ -108,7 +108,7 @@ def test_traces_prefers_full_protocol_rounds():
     assert len(sigs) == 3                # distinct by construction
 
 
-# --- the five models ------------------------------------------------------
+# --- the six models -------------------------------------------------------
 
 def test_all_models_clean_at_default_bound():
     r = run_verify()
@@ -233,7 +233,7 @@ def test_conformance_all_components_match_bit_for_bit(tmp_path):
 
     reports = run_conformance(n_traces=3, workdir=str(tmp_path))
     assert set(reports) == {"checkpoint", "recovery", "lease",
-                            "admission", "repartition"}
+                            "admission", "repartition", "scalepolicy"}
     for name, rep in sorted(reports.items()):
         assert rep.traces >= 3, f"{name}: only {rep.traces} trace(s)"
         assert rep.steps >= rep.traces   # every trace drove real code
