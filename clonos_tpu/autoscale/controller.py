@@ -98,12 +98,12 @@ class DecisionLog:
         self.rows = list(det.from_bytes(data[:whole]))
         self.records = []
         if os.path.exists(self.sidecar_path):
-            with open(self.sidecar_path) as f:
-                for line in f:
-                    try:
-                        self.records.append(json.loads(line))
-                    except json.JSONDecodeError:
-                        break                     # torn tail line
+            # Shared torn-tail discipline (utils/jsonl): a SIGKILLed
+            # writer's torn final line is dropped; mid-file junk raises
+            # naming file:line instead of silently truncating replay.
+            from clonos_tpu.utils.jsonl import read_jsonl
+            self.records = read_jsonl(self.sidecar_path,
+                                      label=self.sidecar_path)
         if len(self.records) < len(self.rows):
             # a torn sidecar invalidates replay for the rows past it —
             # truncate to the shorter prefix, both views must agree.
@@ -254,6 +254,12 @@ class AutoscaleController:
                             action=decision.action, seq=decision.seq,
                             replayed=False)
         self._observe_hooks("log", seq=decision.seq)
+        from clonos_tpu.obs import get_timeline
+        tl = get_timeline()
+        if tl.enabled:
+            tl.record("scale.decision", epoch=decision.epoch,
+                      action=decision.action, seq=decision.seq,
+                      reason=decision.reason, signal_crc=s.crc())
         if decision.scales:
             self.pending = decision
         return decision

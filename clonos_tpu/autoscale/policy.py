@@ -120,12 +120,18 @@ class ScalePolicy:
         stale = st.stale_streak + 1 if lagging else 0
         cooldown = max(0, st.cooldown - 1)
         seq = st.seq + 1
-        healthy = s.failed_subtasks == 0 and not s.unfenced
+        # A cluster with a sustained gray suspect is unhealthy too: a
+        # re-cut would assign key groups to a worker already diagnosed
+        # as limping (obs/detect.py feeds gray_suspects).
+        healthy = (s.failed_subtasks == 0 and not s.unfenced
+                   and s.gray_suspects == 0)
 
         action, delta, tgt_w, tgt_r, reason = (
             HOLD, 0, s.workers, s.replicas_total, "steady")
         if not healthy:
-            reason = "unhealthy"
+            reason = ("gray-suspect" if s.gray_suspects
+                      and s.failed_subtasks == 0 and not s.unfenced
+                      else "unhealthy")
         elif cooldown > 0:
             reason = "cooldown"
         elif over >= cfg.sustain_fences and s.workers < cfg.max_workers:

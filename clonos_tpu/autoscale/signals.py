@@ -55,6 +55,8 @@ class ScaleSignals:
     workers: int = 0            # current keyed parallelism
     failed_subtasks: int = 0    # per-shard health: nonzero = mid-recovery
     unfenced: bool = False      # epoch tail not yet drained at sampling
+    gray_suspects: int = 0      # sustained gray-failure suspects
+    #                             (obs/detect.py); nonzero = unhealthy
 
     def canonical(self) -> bytes:
         """The one byte encoding (sorted-key JSON) the crc covers."""
@@ -90,7 +92,8 @@ class SignalAggregator:
 
     def sample_from(self, snap: Dict[str, Any], *, epoch: int,
                     workers: int, failed_subtasks: int = 0,
-                    unfenced: bool = False) -> ScaleSignals:
+                    unfenced: bool = False,
+                    gray_suspects: int = 0) -> ScaleSignals:
         offered = _pick(snap, "offered-rate",
                         _pick(snap, "target-rate"))
         achieved = _pick(snap, "rate")
@@ -115,6 +118,7 @@ class SignalAggregator:
             workers=int(workers),
             failed_subtasks=int(failed_subtasks),
             unfenced=bool(unfenced),
+            gray_suspects=int(gray_suspects),
         )
         self.last = sig
         return sig

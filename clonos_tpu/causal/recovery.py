@@ -590,6 +590,12 @@ class RecoveryManager:
             # the recovery.* phase spans the cluster runner emits.
             tr.event("recovery.fsm", state=s.name, flat=self.flat_subtask,
                      vertex=self.vertex_id, subtask=self.subtask)
+        from clonos_tpu.obs import get_timeline
+        tl = get_timeline()
+        if tl.enabled:
+            tl.record("recovery.fsm", state=s.name,
+                      flat=self.flat_subtask, vertex=self.vertex_id,
+                      subtask=self.subtask)
 
     # --- events (reference notify* methods) ---------------------------------
 

@@ -53,6 +53,20 @@ def _setup_tracer(args, service: str):
         args.trace_dir, f"trace-{service}.jsonl"))
 
 
+def _setup_timeline(args, service: str):
+    """Opt-in causal timeline: ``--timeline-dir`` installs the process
+    TimelineStore (and an HLC, so every cross-process message carries a
+    causal stamp) writing timeline-<service>.jsonl there. Returns the
+    store or None."""
+    if getattr(args, "timeline_dir", None) is None:
+        return None
+    import os
+    from clonos_tpu.obs import configure_timeline
+    os.makedirs(args.timeline_dir, exist_ok=True)
+    return configure_timeline(service, path=os.path.join(
+        args.timeline_dir, f"timeline-{service}.jsonl"))
+
+
 def _setup_profile(args) -> None:
     """Opt-in overhead attribution: ``--profile`` installs the process
     profiler BEFORE any runner is built (runners bind the process
@@ -93,6 +107,7 @@ def cmd_run(args) -> int:
     from clonos_tpu.runtime.cluster import ClusterRunner
 
     tracer = _setup_tracer(args, "run")
+    _setup_timeline(args, "run")
     _setup_profile(args)
     job = _load_job(args.job)
     runner = ClusterRunner(job, steps_per_epoch=args.steps_per_epoch,
@@ -172,6 +187,7 @@ def cmd_worker(args) -> int:
                                            TaskExecutorClient)
 
     _setup_tracer(args, args.executor_id)
+    _setup_timeline(args, args.executor_id)
     _setup_profile(args)
     ctx = distributed.initialize(args.coordinator, args.num_processes,
                                  args.process_id)
@@ -224,6 +240,7 @@ def cmd_slotworker(args) -> int:
     from clonos_tpu.runtime.scheduler import SliceWorker
 
     tracer = _setup_tracer(args, args.executor_id)
+    _setup_timeline(args, args.executor_id)
     _setup_profile(args)
     host, _, port = args.jm.partition(":")
     worker = SliceWorker(
@@ -265,6 +282,7 @@ def cmd_dispatcher(args) -> int:
     from clonos_tpu.runtime.dispatcher import Dispatcher
 
     _setup_tracer(args, "dispatcher")
+    _setup_timeline(args, "dispatcher")
     _setup_profile(args)
     if args.audit:
         from clonos_tpu.obs import configure_audit
@@ -660,6 +678,21 @@ def _top_table(snap) -> str:
         lines.append("")
         lines.append("autoscale: " + "  ".join(
             f"{k}={v}" for k, v in sorted(autoscale.items())))
+    # Health status row: the gray-failure detector's cluster.health.*
+    # gauges (sustained suspects, events, fences scored) — same suffix
+    # matching as soak:/serve:, so the row survives any prefix.
+    health = {}
+    for k, v in sorted(snap.items()):
+        if not isinstance(v, (int, float)) or isinstance(v, bool):
+            continue
+        if k.startswith("cluster.health."):
+            health[k[len("cluster.health."):]] = v
+        elif ".cluster.health." in k:
+            health.setdefault(k.rsplit(".cluster.health.", 1)[1], v)
+    if health:
+        lines.append("")
+        lines.append("health: " + "  ".join(
+            f"{k}={v}" for k, v in sorted(health.items())))
     tenant = {k: v for k, v in sorted(snap.items())
               if (k.startswith("tenant.")
                   or k.startswith("dispatcher."))
@@ -671,11 +704,19 @@ def _top_table(snap) -> str:
     cluster = {k: v for k, v in sorted(snap.items())
                if k.startswith("cluster.")
                and not k.startswith("cluster.job.")
+               and not k.startswith("cluster.health.")
                and isinstance(v, (int, float))}
     if cluster:
         lines.append("")
         lines.append("cluster: " + "  ".join(
             f"{k[len('cluster.'):]}={v}" for k, v in cluster.items()))
+    # Trace-ring truncation: a nonzero dropped count means the flight
+    # recorder (and /trace) no longer holds the full run.
+    dropped = snap.get("trace.dropped-records")
+    if isinstance(dropped, (int, float)) and dropped:
+        lines.append("")
+        lines.append(f"trace: dropped-records={int(dropped)} "
+                     f"(flight-recorder ring truncated)")
     return "\n".join(lines)
 
 
@@ -962,6 +1003,114 @@ def cmd_trace(args) -> int:
     return 0
 
 
+def cmd_timeline(args) -> int:
+    """Merge, check, filter, diff and export causal timelines
+    (``clonos_tpu timeline``): any number of per-process
+    timeline-*.jsonl files reconstruct ONE HLC-ordered incident
+    timeline; ``--report json`` is the causality gate (exit 1 on any
+    inversion); ``--diff`` compares two runs structurally; ``--chrome``
+    exports through the same validated trace_event path as
+    ``clonos_tpu trace``."""
+    from clonos_tpu import obs
+
+    if args.self_check:
+        findings = obs.timeline_self_check()
+        print(json.dumps({"ok": not findings, "check": "hlc-causality",
+                          "inversions": findings}))
+        return 0 if not findings else 1
+
+    if not args.files:
+        print("timeline: at least one timeline-*.jsonl file required "
+              "(or --self-check)", file=sys.stderr)
+        return 2
+    records = obs.read_timeline(args.files)
+    if args.trace:
+        records = records + obs.from_trace_records(
+            obs.load_jsonl(args.trace))
+    # inversions are checked over the FULL merged set — filters narrow
+    # what is shown, never what is proven
+    inversions = obs.causality_inversions(records)
+    merged = obs.merge_records(records)
+
+    def _match(rec) -> bool:
+        if args.kind and not str(rec.get("kind", "")).startswith(
+                args.kind):
+            return False
+        if args.job is not None and str(
+                rec.get("job", rec.get("service", ""))) != args.job:
+            return False
+        if args.epoch is not None and rec.get("epoch") != args.epoch:
+            return False
+        if args.worker is not None:
+            cands = [rec.get("worker"), rec.get("flat"),
+                     rec.get("subtask")]
+            targets = rec.get("targets")
+            if isinstance(targets, list):
+                cands.extend(targets)
+            if args.worker not in {str(c) for c in cands
+                                   if c is not None}:
+                return False
+        return True
+
+    shown = [r for r in merged if _match(r)]
+
+    if args.diff is not None:
+        other = obs.read_timeline(args.diff)
+        findings = obs.diff_timelines(shown,
+                                      [r for r in obs.merge_records(other)
+                                       if _match(r)])
+        if args.report == "json":
+            print(json.dumps({"match": not findings,
+                              "only_a": sum(f["count"] for f in findings
+                                            if f["only"] == "a"),
+                              "only_b": sum(f["count"] for f in findings
+                                            if f["only"] == "b")}))
+        else:
+            for f in findings:
+                print(f"only in {'A' if f['only'] == 'a' else 'B'} "
+                      f"(x{f['count']}): "
+                      f"{json.dumps(f['record'], sort_keys=True)}")
+            print(f"{'match' if not findings else 'DIVERGED'}: "
+                  f"{len(findings)} differing record shapes")
+        return 0 if not findings else 1
+
+    if args.chrome:
+        doc = obs.to_chrome(obs.to_trace_records(shown))
+        n = obs.validate_chrome(doc)
+        with open(args.chrome, "w") as f:
+            json.dump(doc, f)
+        print(json.dumps({"events": n, "out": args.chrome}))
+        return 0
+
+    if args.report == "json":
+        by_kind: dict = {}
+        for r in shown:
+            k = str(r.get("kind", "?"))
+            by_kind[k] = by_kind.get(k, 0) + 1
+        print(json.dumps({"ok": not inversions, "records": len(merged),
+                          "shown": len(shown),
+                          "by_kind": dict(sorted(by_kind.items())),
+                          "inversions": inversions}))
+        return 0 if not inversions else 1
+
+    for r in shown:
+        hlc = r.get("hlc")
+        stamp = (f"{hlc[0]}.{hlc[1]}@{hlc[2]}" if hlc
+                 else f"~{r.get('ts', 0):.6f}")
+        extras = " ".join(
+            f"{k}={v}" for k, v in sorted(r.items())
+            if k not in ("kind", "ts", "hlc", "service", "pid"))
+        print(f"{stamp:<40} [{r.get('service')}] "
+              f"{r.get('kind')} {extras}".rstrip())
+    if inversions:
+        print(f"\nCAUSALITY INVERSIONS: {len(inversions)}",
+              file=sys.stderr)
+        for f in inversions:
+            print(f"  {f['rule']}: {f['detail']} "
+                  f"(verb={f.get('verb')})", file=sys.stderr)
+    return 0 if not inversions else 1
+
+
 def cmd_soak(args) -> int:
     """Open-loop soak run (``clonos_tpu soak``): paced load at a fixed
     ingestion rate, a seeded (or explicit) chaos schedule, windowed SLO
@@ -978,7 +1127,11 @@ def cmd_soak(args) -> int:
                                  next_soak_artifact_path, parse_schedule)
 
     tracer = _setup_tracer(args, "soak")
+    _setup_timeline(args, "soak")
     _setup_profile(args)
+    if args.detect_gray:
+        from clonos_tpu.obs import configure_detector
+        configure_detector()
     workdir = args.workdir or tempfile.mkdtemp(prefix="clonos-soak-")
     runner, control, election = build_soak_fixture(
         workdir, rate=args.rate, duration_s=args.duration,
@@ -1071,6 +1224,10 @@ def cmd_soak(args) -> int:
             line["autoscale_rescales"] = asc["autoscale_rescales"]
             line["operator_rescale_events"] = \
                 asc["operator_rescale_events"]
+        if "health" in verdict:
+            hl = verdict["health"]
+            line["gray_suspects"] = hl["suspects"]
+            line["gray_replay_ok"] = hl["replay_bit_identical"]
         print(json.dumps(line))
         return rc
     lat = verdict["latency"]
@@ -1088,6 +1245,12 @@ def cmd_soak(args) -> int:
     print(f"audit: exactly_once={a['exactly_once']} "
           f"({a['epochs_checked']} epochs checked, "
           f"{len(a['divergences'])} divergences)")
+    if "health" in verdict:
+        hl = verdict["health"]
+        print(f"health: suspects={hl['suspects']} "
+              f"gray_events={hl['gray_events']} "
+              f"fences_scored={hl['fences_scored']} "
+              f"replay_ok={hl['replay_bit_identical']}")
     if "autoscale" in verdict:
         asc = verdict["autoscale"]
         print(f"autoscale: {asc['decisions']} decisions "
@@ -1122,6 +1285,9 @@ def main(argv=None) -> int:
     pr.add_argument("--trace-dir", default=None,
                     help="record trace spans to trace-run.jsonl here "
                          "(off by default: zero overhead)")
+    pr.add_argument("--timeline-dir", default=None,
+                    help="record HLC-stamped causal events to "
+                         "timeline-run.jsonl here (off by default)")
     _add_profile_args(pr)
     pr.set_defaults(fn=cmd_run)
 
@@ -1202,6 +1368,9 @@ def main(argv=None) -> int:
     pw.add_argument("--trace-dir", default=None,
                     help="record trace spans to "
                          "trace-<executor-id>.jsonl here")
+    pw.add_argument("--timeline-dir", default=None,
+                    help="record HLC-stamped causal events to "
+                         "timeline-<executor-id>.jsonl here")
     pw.add_argument("--profile", action="store_true",
                     help="attribute fault-tolerance overhead per section "
                          "(overhead.* metrics; off by default: zero "
@@ -1239,6 +1408,11 @@ def main(argv=None) -> int:
                          "trace-<executor-id>.jsonl here; DEPLOY "
                          "headers make the spans join the JobMaster's "
                          "trace id (off by default: zero overhead)")
+    ps.add_argument("--timeline-dir", default=None,
+                    help="record HLC-stamped causal events to "
+                         "timeline-<executor-id>.jsonl here; merges "
+                         "with the JobMaster's file via `clonos_tpu "
+                         "timeline` (off by default)")
     _add_profile_args(ps)
     ps.set_defaults(fn=cmd_slotworker)
 
@@ -1284,6 +1458,9 @@ def main(argv=None) -> int:
     pc.add_argument("--trace-dir", default=None,
                     help="per-job trace files "
                          "(trace-jm.<job-id>.jsonl) land here")
+    pc.add_argument("--timeline-dir", default=None,
+                    help="record HLC-stamped causal events to "
+                         "timeline-dispatcher.jsonl here")
     _add_profile_args(pc)
     pc.set_defaults(fn=cmd_dispatcher)
 
@@ -1334,6 +1511,44 @@ def main(argv=None) -> int:
                     help="also print the dominant trace's ordered "
                          "event timeline")
     pt.set_defaults(fn=cmd_trace)
+
+    pm = sub.add_parser("timeline",
+                        help="merge, check and export causal timelines "
+                             "(HLC-ordered, cross-process)")
+    pm.add_argument("files", nargs="*",
+                    help="timeline-*.jsonl files (each process writes "
+                         "one; together they reconstruct ONE causally-"
+                         "ordered incident timeline)")
+    pm.add_argument("--trace", action="append", default=[],
+                    metavar="FILE",
+                    help="also merge tracer trace-*.jsonl files "
+                         "(wall-clock ordered within their process)")
+    pm.add_argument("--kind", default=None,
+                    help="show only records whose kind starts with "
+                         "this (e.g. msg., epoch.seal, health.)")
+    pm.add_argument("--job", default=None,
+                    help="show only records of this job / service")
+    pm.add_argument("--worker", default=None,
+                    help="show only records naming this worker / "
+                         "flat subtask")
+    pm.add_argument("--epoch", type=int, default=None,
+                    help="show only records of this epoch")
+    pm.add_argument("--diff", default=None, metavar="FILE",
+                    help="second run's timeline file(s); structural "
+                         "record diff (volatile fields ignored), "
+                         "exit 1 on divergence")
+    pm.add_argument("--chrome", default=None, metavar="OUT",
+                    help="write Chrome trace_event JSON of the merged "
+                         "timeline (validated; load in Perfetto)")
+    pm.add_argument("--report", choices=["json"], default=None,
+                    help="machine-readable summary for CI: one JSON "
+                         "line {ok, records, by_kind, inversions}; "
+                         "exit 0 iff zero causality inversions")
+    pm.add_argument("--self-check", action="store_true",
+                    help="run the deterministic in-memory HLC "
+                         "causality self-check instead of reading "
+                         "files (the conftest gate)")
+    pm.set_defaults(fn=cmd_timeline)
 
     pa = sub.add_parser("audit", help="print or diff a job's epoch "
                                       "audit ledger")
@@ -1430,6 +1645,18 @@ def main(argv=None) -> int:
     pk.add_argument("--trace-dir", default=None,
                     help="record soak/chaos/breach trace spans to "
                          "trace-soak.jsonl here")
+    pk.add_argument("--timeline-dir", default=None,
+                    help="record the unified causal timeline (chaos / "
+                         "epoch seals / scale decisions / SLO breaches "
+                         "/ gray suspicion, HLC-stamped) to "
+                         "timeline-soak.jsonl here (off by default: "
+                         "zero overhead)")
+    pk.add_argument("--detect-gray", action="store_true",
+                    help="score the gray-failure detector at every "
+                         "completed fence (cluster.health.* gauges, "
+                         "health.gray-suspect timeline events, and a "
+                         "health section in the verdict; feeds the "
+                         "autoscaler's unhealthy arm)")
     _add_profile_args(pk)
     pk.set_defaults(fn=cmd_soak)
 

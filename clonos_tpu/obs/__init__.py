@@ -23,6 +23,17 @@ from .audit import (Auditor, NullAuditor, configure_audit,  # noqa: F401
 from .profile import (NullProfiler, Profiler, configure_profile,  # noqa: F401
                       get_profiler, reset_profile)
 from .history import MetricsHistory, read_history_file  # noqa: F401
+from .hlc import (HybridLogicalClock, NullHLC, configure_hlc,  # noqa: F401
+                  get_hlc, reset_hlc, stamp_key)
+from .timeline import (NullTimeline, TimelineStore,  # noqa: F401
+                       causality_inversions, configure_timeline,
+                       diff_timelines, from_trace_records, get_timeline,
+                       merge_records, read_timeline, reset_timeline,
+                       timeline_self_check, to_trace_records)
+from .detect import (DetectorConfig, DetectorState,  # noqa: F401
+                     GrayFailureDetector, GraySnapshot, GrayVerdict,
+                     NullDetector, configure_detector, detect_gray,
+                     get_detector, reset_detector, score_gray)
 
 __all__ = ["Tracer", "NullTracer", "get_tracer", "configure", "reset",
            "load_jsonl", "to_chrome", "validate_chrome", "summarize",
@@ -31,4 +42,15 @@ __all__ = ["Tracer", "NullTracer", "get_tracer", "configure", "reset",
            "reset_audit", "digest_epoch_window",
            "Profiler", "NullProfiler", "get_profiler",
            "configure_profile", "reset_profile",
-           "MetricsHistory", "read_history_file"]
+           "MetricsHistory", "read_history_file",
+           "HybridLogicalClock", "NullHLC", "get_hlc", "configure_hlc",
+           "reset_hlc", "stamp_key",
+           "TimelineStore", "NullTimeline", "get_timeline",
+           "configure_timeline", "reset_timeline", "read_timeline",
+           "merge_records", "causality_inversions", "diff_timelines",
+           "from_trace_records", "to_trace_records",
+           "timeline_self_check",
+           "GraySnapshot", "GrayVerdict", "DetectorConfig",
+           "DetectorState", "GrayFailureDetector", "NullDetector",
+           "detect_gray", "score_gray", "get_detector",
+           "configure_detector", "reset_detector"]

@@ -77,6 +77,7 @@ class NullTracer:
     enabled = False
     trace_id = None
     service = None
+    dropped = 0
 
     def span(self, name: str, **args) -> _NullSpan:
         return _NULL_SPAN
@@ -156,6 +157,11 @@ class Tracer:
         self._local = threading.local()
         #: the flight recorder: most recent records, bounded
         self._ring: Deque[dict] = collections.deque(maxlen=buffer)
+        #: records evicted from the ring at overflow — a nonzero count
+        #: means the in-memory timeline is TRUNCATED (the file sink, if
+        #: any, still has everything). Surfaced as the
+        #: ``trace.dropped-records`` counter in /metrics.json and top.
+        self.dropped = 0
         # clonos: allow(entropy): trace metadata, never replayed data
         self._pid = os.getpid()
 
@@ -195,6 +201,8 @@ class Tracer:
         if args:
             rec["args"] = args
         with self._lock:
+            if len(self._ring) == self._ring.maxlen:
+                self.dropped += 1      # eviction, not silence
             self._ring.append(rec)
             if self._path is not None:
                 # One append-mode handle for the tracer's lifetime,

@@ -170,6 +170,50 @@ def adopt_profile(header: Dict[str, Any]) -> None:
         configure_profile()
 
 
+# --- hlc-context convention --------------------------------------------------
+# Same shape once more, for the unified causal timeline (obs/hlc.py +
+# obs/timeline.py): every cross-process control message (DEPLOY,
+# HEARTBEAT, FETCH_EDGE, DETERMINANT_REQUEST, serve verbs) MAY carry an
+# ``hlc`` field — the sender's hybrid-logical-clock stamp. The receiver
+# folds it into its own clock (the HLC receive rule), so the two
+# processes' timeline records merge into one causally-consistent order
+# no matter how their wall clocks disagree. A disabled clock attaches
+# NOTHING: hlc-off wire bytes stay identical to a pre-HLC build.
+
+def attach_hlc(header: Dict[str, Any],
+               verb: Optional[str] = None) -> Dict[str, Any]:
+    """Tick the process HLC and stamp a JSON header (in place); emits a
+    ``msg.send`` timeline record carrying the same stamp."""
+    from clonos_tpu.obs import get_hlc, get_timeline
+    h = get_hlc()
+    if h.enabled:
+        stamp = h.tick()
+        header["hlc"] = {"ts": [stamp[0], stamp[1]], "node": stamp[2]}
+        tl = get_timeline()
+        if tl.enabled:
+            tl.record("msg.send", hlc=stamp, verb=verb)
+    return header
+
+
+def adopt_hlc(header: Dict[str, Any],
+              verb: Optional[str] = None) -> None:
+    """Fold a received header's ``hlc`` stamp into the process clock
+    (no-op when either side has no clock); emits a ``msg.recv``
+    timeline record echoing the sender's stamp so causality is
+    checkable per record."""
+    from clonos_tpu.obs import get_hlc, get_timeline
+    h = get_hlc()
+    ctx = header.get("hlc")
+    if h.enabled and isinstance(ctx, dict) and "ts" in ctx:
+        sent = (int(ctx["ts"][0]), int(ctx["ts"][1]),
+                str(ctx.get("node", "?")))
+        stamp = h.observe(sent)
+        tl = get_timeline()
+        if tl.enabled:
+            tl.record("msg.recv", hlc=stamp, verb=verb,
+                      sent=list(sent))
+
+
 class ControlServer:
     """Threaded request/response endpoint. ``handler(mtype, payload) ->
     (mtype, payload)`` runs per request; one TCP connection may carry many

@@ -112,6 +112,18 @@ class HeartbeatMonitor:
                if freshest - t > grace_s and now - t <= self.timeout_s]
         return sorted(out)
 
+    def ages_ms(self) -> Dict[int, float]:
+        """Per-subtask beat age behind the FRESHEST live beat, in ms —
+        the peer-relative evidence the gray-failure detector scores
+        (obs/detect.py). 0.0 for the freshest worker; empty when no one
+        is alive."""
+        alive = {s: t for s, t in self._last.items()
+                 if s not in self._dead}
+        if not alive:
+            return {}
+        freshest = max(alive.values())
+        return {s: (freshest - t) * 1e3 for s, t in alive.items()}
+
     def revive(self, subtask: int) -> None:
         self._dead.discard(subtask)
         self.lag.pop(subtask, None)
@@ -1756,6 +1768,11 @@ class ClusterRunner:
         # max(): the pipelined fence may run this on the worker while a
         # drain-ordering edge case replays an older epoch's tail.
         self.last_sealed_epoch = max(self.last_sealed_epoch, closed)
+        from clonos_tpu.obs import get_timeline
+        tl = get_timeline()
+        if tl.enabled:
+            tl.record("epoch.seal", epoch=int(closed),
+                      audited=bool(self.auditor.enabled))
         if self.serve_feeds:
             t = _time.monotonic()
             for fn in list(self.serve_feeds):

@@ -175,6 +175,8 @@ class QueryableStateEndpoint:
                 {"epoch": epoch, "staleness_epochs": 0,
                  "role": "owner", "reads": self.reads})
         req = tp.unpack_json(payload)
+        tp.adopt_hlc(req, verb="QUERY_STATE" if mtype == tp.QUERY_STATE
+                     else "QUERY_BATCH")
         got = self._resolve(req)
         if len(got) == 2:
             return got
@@ -232,6 +234,10 @@ class QueryableStateClient:
                                         timeout_s=self.timeout_s)
 
     def _call(self, mtype: int, payload: dict) -> dict:
+        if mtype in (tp.QUERY_STATE, tp.QUERY_BATCH):
+            tp.attach_hlc(payload,
+                          verb="QUERY_STATE" if mtype == tp.QUERY_STATE
+                          else "QUERY_BATCH")
         rt, resp = _call_with_retry(
             self._client, mtype, tp.pack_json(payload), self.address,
             self.timeout_s, self.retries, self.backoff_s)

@@ -79,6 +79,7 @@ class JobMasterServer:
             return tp.OK, tp.pack_json({"registered": True})
         if mtype == tp.HEARTBEAT:
             info = tp.unpack_json(payload)
+            tp.adopt_hlc(info, verb="HEARTBEAT")
             with self._lock:
                 self._last[info["executor_id"]] = time.monotonic()
                 metrics = info.get("metrics")
@@ -261,6 +262,7 @@ class TaskExecutorClient:
                         msg.update(self._payload_fn() or {})
                     except Exception:
                         pass       # the beat matters more than the extras
+                tp.attach_hlc(msg, verb="HEARTBEAT")
                 self._client.call_json(tp.HEARTBEAT, msg)
                 self.missed_beats = 0
             except (OSError, RuntimeError):
@@ -376,6 +378,7 @@ class HostLogEndpoint:
         known = req.get("known_heads", {})
         encoding = req.get("encoding", "flat")
         tp.adopt_trace(req)
+        tp.adopt_hlc(req, verb="DETERMINANT_REQUEST")
         tr = get_tracer()
         deltas = []
         floors: Dict[int, int] = {}
@@ -558,8 +561,10 @@ class RemoteReplicaMirror:
         mirror applies the same truncation: rebase to the delta's start
         and absorb from there (a remote notifyCheckpointComplete)."""
         known = {str(f): self.head(f) for f in self.flats}
-        req = tp.attach_trace({"flats": self.flats, "known_heads": known,
-                               "encoding": self.encoding})
+        req = tp.attach_hlc(
+            tp.attach_trace({"flats": self.flats, "known_heads": known,
+                             "encoding": self.encoding}),
+            verb="DETERMINANT_REQUEST")
         rt, resp = self._client.call(tp.DETERMINANT_REQUEST,
                                      tp.pack_json(req))
         if rt == tp.ERROR:

@@ -432,6 +432,7 @@ class EdgeExportServer:
             return tp.ERROR, tp.pack_json({"error": f"bad mtype {mtype}"})
         req = tp.unpack_json(payload)
         tp.adopt_trace(req)
+        tp.adopt_hlc(req, verb="FETCH_EDGE")
         eidx, start, count = (int(req["edge"]), int(req["start"]),
                               int(req["count"]))
         if eidx not in self._recs:
@@ -497,9 +498,9 @@ class RemoteEdgeFeedReader:
             with self._lock:
                 rt, resp = self._client.call(
                     tp.FETCH_EDGE,
-                    tp.pack_json(tp.attach_trace(
+                    tp.pack_json(tp.attach_hlc(tp.attach_trace(
                         {"edge": self._edge, "start": start,
-                         "count": n})))
+                         "count": n}), verb="FETCH_EDGE")))
             if rt == tp.ERROR:
                 raise RuntimeError(tp.unpack_json(resp)["error"])
             hlen = int.from_bytes(resp[:4], "little")
@@ -779,6 +780,7 @@ class SliceWorker:
         tp.adopt_trace(tdd)
         tp.adopt_audit(tdd)
         tp.adopt_profile(tdd)
+        tp.adopt_hlc(tdd, verb="DEPLOY")
         tr = get_tracer()
         self._task_state(group, "DEPLOYING", job_id=jid, attempt=attempt)
         job = _load_job(tdd["job"])
@@ -1106,7 +1108,8 @@ class SlotPoolScheduler:
         ctx = self._tr().wire_context()
         if ctx is not None:
             hdr["trace"] = ctx
-        tdd = tp.attach_profile(tp.attach_audit(hdr))
+        tdd = tp.attach_hlc(tp.attach_profile(tp.attach_audit(hdr)),
+                            verb="DEPLOY")
         span_kw = {"job": self.job_id} if self.job_id else {}
         t0 = time.monotonic()
         with self._tr().span("deploy", group=group, worker=worker_id,

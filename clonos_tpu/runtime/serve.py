@@ -363,6 +363,8 @@ class ReplicaServeEndpoint:
         if mtype not in (tp.QUERY_STATE, tp.QUERY_BATCH):
             return tp.ERROR, tp.pack_json({"error": f"bad mtype {mtype}"})
         req = tp.unpack_json(payload)
+        tp.adopt_hlc(req, verb="QUERY_STATE" if mtype == tp.QUERY_STATE
+                     else "QUERY_BATCH")
         if req["vertex"] != self.replica.vertex_id or \
                 req.get("state", "acc") != self.replica.state_name:
             return tp.ERROR, tp.pack_json(
@@ -496,6 +498,10 @@ class ReplicaStateClient:
                                         timeout_s=self.timeout_s)
 
     def _call(self, mtype: int, payload: dict) -> dict:
+        if mtype in (tp.QUERY_STATE, tp.QUERY_BATCH):
+            tp.attach_hlc(payload,
+                          verb="QUERY_STATE" if mtype == tp.QUERY_STATE
+                          else "QUERY_BATCH")
         rt, resp = _call_with_retry(
             self._client, mtype, tp.pack_json(payload), self.address,
             self.timeout_s, self.retries, self.backoff_s)

@@ -36,6 +36,7 @@ import numpy as np
 
 from clonos_tpu.autoscale import SignalAggregator
 from clonos_tpu.obs import get_tracer
+from clonos_tpu.obs.detect import GraySnapshot, get_detector
 from clonos_tpu.obs.digest import diff_ledgers
 
 from .chaos import ChaosEvent, ChaosSchedule
@@ -149,6 +150,11 @@ class SoakHarness:
         self.tracer.event("soak.chaos", kind=event.kind,
                           at_s=round(now_s, 3),
                           targets=list(event.targets))
+        from clonos_tpu.obs import get_timeline
+        tl = get_timeline()
+        if tl.enabled:
+            tl.record("chaos", chaos_kind=event.kind,
+                      at_s=round(now_s, 3), targets=list(event.targets))
         self.faults_injected += 1
         self.by_kind[event.kind] = self.by_kind.get(event.kind, 0) + 1
         getattr(self, "_apply_" + event.kind.replace("-", "_"))(
@@ -481,7 +487,7 @@ class SoakDriver:
                  spec: Optional[SLOSpec] = None,
                  control=None, election=None,
                  records_per_step: Optional[int] = None,
-                 read_load=None, autoscaler=None):
+                 read_load=None, autoscaler=None, detector=None):
         self.runner = runner
         self.cfg = config
         self.schedule = schedule if schedule is not None \
@@ -509,6 +515,12 @@ class SoakDriver:
         #: completed+drained fence and lets the controller decide and
         #: execute — worker re-cuts ride harness.autoscale_rescale
         #: (zero operator events), replica moves ride the serve tier.
+        #: gray-failure detector (obs/detect.py): scored at every
+        #: completed+drained fence; its sustained-suspect count feeds
+        #: the signal plane's unhealthy arm. Defaults to the process
+        #: detector — NullDetector unless configure_detector() ran.
+        self.detector = detector if detector is not None \
+            else get_detector()
         self.autoscaler = autoscaler
         self._signals = None
         if autoscaler is not None:
@@ -547,6 +559,10 @@ class SoakDriver:
         g.gauge("rescales", lambda: h.rescales)
         g.gauge("degraded-workers", lambda: len(
             self.runner.heartbeats.degraded(cfg.degraded_grace_s)))
+        if self.detector.enabled:
+            # cluster.health.* rides the same rollup — re-registered
+            # (like soak.*) on the NEW incarnation's registry
+            self.detector.register_gauges(self.runner.metrics)
         if self.autoscaler is not None:
             # autoscale.* rides the same rollup — re-registered (like
             # soak.*) on the NEW incarnation's registry after a re-cut
@@ -583,6 +599,42 @@ class SoakDriver:
         self.tracer.event("soak.leader.reacquired",
                           pause_ms=round(ms, 1))
 
+    # --- gray-failure detection ----------------------------------------------
+
+    def _detect_fence(self, r, ex) -> None:
+        """One detector evaluation at a completed+drained fence: build
+        the pinnable :class:`GraySnapshot` off the same rollup the
+        signal plane samples (plus the heartbeat monitor's peer-relative
+        ages) and run the pure scorer. Emits ``health.gray-suspect``
+        timeline events and updates the ``cluster.health.suspects``
+        gauge — BEFORE the autoscale sample of the same fence, so the
+        policy's unhealthy arm sees this fence's verdict."""
+        snap = r.metrics.snapshot()
+        staleness = {
+            k[:-len(".staleness-epochs")]: float(v)
+            for k, v in snap.items()
+            if k.endswith(".staleness-epochs")
+            and isinstance(v, (int, float))}
+        epoch_ms = {}
+        for k, v in snap.items():
+            # per-worker epoch timing from the cluster rollup
+            # (worker.<eid>.….epoch.steps-ms histograms)
+            if k.endswith(".epoch.steps-ms") and k.startswith("worker.") \
+                    and isinstance(v, dict):
+                epoch_ms[k.split(".", 2)[1]] = float(v.get("mean", 0.0))
+        stall = 0.0
+        for k, v in snap.items():
+            if k.endswith("epoch.fence-ms") and isinstance(v, dict):
+                stall = max(stall,
+                            float(v.get("p99", 0.0))
+                            - float(v.get("p50", 0.0)))
+        self.detector.on_fence(GraySnapshot.build(
+            epoch=ex.epoch_id,
+            hb_age_ms={f"w{f}": a
+                       for f, a in r.heartbeats.ages_ms().items()},
+            epoch_ms=epoch_ms, staleness=staleness,
+            fence_stall_ms=stall))
+
     # --- the closed loop -----------------------------------------------------
 
     def _autoscale_fence(self, r, ex, now_s: float):
@@ -599,7 +651,8 @@ class SoakDriver:
             r.metrics.snapshot(), epoch=ex.epoch_id,
             workers=_keyed_parallelism(r),
             failed_subtasks=len(r.heartbeats.expired()),
-            unfenced=r.fence_tail_in_flight())
+            unfenced=r.fence_tail_in_flight(),
+            gray_suspects=len(self.detector.suspects()))
         decision, executed = self.autoscaler.on_fence(ex.epoch_id, sigs)
         if executed is not None and h.runner is not r:
             # a worker re-cut ran: the fence stall is an outage the
@@ -828,6 +881,12 @@ class SoakDriver:
                         r = self.runner = h.runner
                         ex = r.executor
                         self._register_gauges()
+                    if fence_drained and self.detector.enabled:
+                        # gray-failure scoring at the same fence cadence
+                        # as the signal plane, and BEFORE its sample —
+                        # this fence's verdict reaches this fence's
+                        # policy evaluation
+                        self._detect_fence(r, ex)
                     if self.autoscaler is not None and fence_drained:
                         # the closed loop: signals sampled off the
                         # metric rollup at THIS completed+drained
@@ -967,6 +1026,23 @@ class SoakDriver:
             # contended with (the honest-measurement requirement).
             out["serve"] = self.read_load.summary()
             out["serve"]["replica_kills"] = h.replica_kills
+        if self.detector.enabled:
+            # Gray-failure verdict: the sustained suspects at run end,
+            # the per-fence scoring history length, and a bit-identical
+            # replay proof over the pinned snapshots (the same
+            # discipline the SCALE log's verdict pins with its digest).
+            d = self.detector
+            try:
+                d.replay()
+                replay_ok = True
+            except ValueError:
+                replay_ok = False
+            out["health"] = {
+                "suspects": d.suspects(),
+                "gray_events": d.events_emitted,
+                "fences_scored": len(d.log),
+                "replay_bit_identical": replay_ok,
+            }
         if self.autoscaler is not None:
             # Closed-loop verdict: every decision is in the SCALE log
             # (digest pins the byte encoding), scale actions are rate-

@@ -132,10 +132,16 @@ class SLOTracker:
 
     def _close(self, win: Window) -> None:
         breaches = win.evaluate(self.spec)
-        if breaches and self.tracer is not None:
-            for b in breaches:
-                self.tracer.event("soak.slo.breach", window=win.index,
-                                  breach=b)
+        if breaches:
+            if self.tracer is not None:
+                for b in breaches:
+                    self.tracer.event("soak.slo.breach",
+                                      window=win.index, breach=b)
+            from clonos_tpu.obs import get_timeline
+            tl = get_timeline()
+            if tl.enabled:
+                for b in breaches:
+                    tl.record("slo.breach", window=win.index, breach=b)
         self.closed.append(win)
 
     def observe(self, now_s: float, corrected_ms: float,

@@ -384,6 +384,11 @@ class MetricsEndpoint:
                     snap.update(extra())
                 except Exception as e:
                     snap["extra-error"] = repr(e)
+            if tracer is not None and getattr(tracer, "enabled", False):
+                # ring-overflow visibility: nonzero means the in-memory
+                # flight recorder (and /trace) is TRUNCATED
+                snap["trace.dropped-records"] = getattr(
+                    tracer, "dropped", 0)
             return snap
 
         self._history = history

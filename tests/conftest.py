@@ -103,6 +103,18 @@ def pytest_configure(config):
         raise pytest.UsageError(
             "protocol model check failed (clonos_tpu verify --quick):\n"
             + v_format(vresult))
+    # Timeline causality gate (clonos_tpu timeline --self-check): two
+    # skew-clocked simulated processes exchange HLC-stamped messages;
+    # the merged stream must show zero inversions. Pure and sub-
+    # millisecond — a broken receive rule fails the session here, not
+    # in a flaky multi-process soak.
+    from clonos_tpu.obs.timeline import timeline_self_check
+    findings = timeline_self_check()
+    if findings:
+        raise pytest.UsageError(
+            "HLC causality self-check failed (clonos_tpu timeline "
+            "--self-check): " + "; ".join(
+                f"[{f['rule']}] {f['detail']}" for f in findings))
 
 
 @pytest.fixture

@@ -1,0 +1,513 @@
+"""Causal timeline + gray-failure detection plane (clonos_tpu/obs/).
+
+The HLC layers first: the clock's send/receive rules must order every
+receive after its send no matter how badly the two processes' wall
+clocks disagree, and the merged two-process record stream must show
+zero causality inversions under seeded random interleavings. Then the
+reader contract (torn tail dropped, mid-file junk refused with
+file:line), the pure gray-failure detector (peer-relative scoring,
+sustained-streak suspects, bit-identical replay from the pinned
+snapshot log), and the ``clonos_tpu timeline`` CLI exit-0/1 contract.
+The acceptance tests at the bottom run the real thing: a SIGKILLed
+child process whose timeline file merges cleanly with the parent's,
+and a gray soak where the suspect event lands BEFORE the first SLO
+breach.
+"""
+
+import json
+import os
+import random
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from clonos_tpu.obs.detect import (DetectorConfig, DetectorState,
+                                   GrayFailureDetector, GraySnapshot,
+                                   detect_gray, reset_detector,
+                                   score_gray)
+from clonos_tpu.obs.hlc import (HybridLogicalClock, reset_hlc,
+                                stamp_key)
+from clonos_tpu.obs.timeline import (TimelineStore, causality_inversions,
+                                     configure_timeline, diff_timelines,
+                                     merge_records, read_timeline,
+                                     reset_timeline, timeline_self_check)
+from clonos_tpu.soak import parse_schedule
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs_globals():
+    yield
+    reset_detector()
+    reset_timeline()
+    reset_hlc()
+
+
+def _fake_clock(start: float, step: float = 0.001):
+    """A deterministic wall clock: starts skewed, advances per read."""
+    t = [start]
+
+    def clock():
+        t[0] += step
+        return t[0]
+
+    return clock
+
+
+# --- hybrid logical clock ----------------------------------------------------
+
+
+def test_hlc_tick_is_strictly_monotonic_under_frozen_clock():
+    t = [100.0]
+    h = HybridLogicalClock("a", clock=lambda: t[0])  # wall time frozen
+    stamps = [h.tick() for _ in range(50)]
+    for prev, nxt in zip(stamps, stamps[1:]):
+        assert stamp_key(nxt) > stamp_key(prev)
+    # frozen physical time advances the logical component only
+    assert stamps[0][0] == stamps[-1][0]
+    assert stamps[-1][1] > stamps[0][1]
+
+
+def test_hlc_observe_orders_receive_after_send_despite_skew():
+    # the receiver's wall clock is 30 SECONDS behind the sender's:
+    # physical timestamps alone would order every receive before its
+    # send; the observe rule must not.
+    sender = HybridLogicalClock("fast", clock=_fake_clock(1000.0))
+    receiver = HybridLogicalClock("slow", clock=_fake_clock(970.0))
+    for _ in range(200):
+        sent = sender.tick()
+        recv = receiver.observe(sent)
+        assert stamp_key(recv) > stamp_key(sent)
+
+
+def test_hlc_merged_streams_show_no_inversions_seeded_interleavings():
+    """The property the whole plane hangs on: two processes with badly
+    skewed clocks exchange messages in seeded-random interleavings and
+    the merged, HLC-ordered record stream NEVER shows a receive before
+    its send."""
+    rng = random.Random(7)
+    for trial in range(20):
+        skew = rng.uniform(-60.0, 60.0)
+        clocks = {"a": HybridLogicalClock("a", clock=_fake_clock(500.0)),
+                  "b": HybridLogicalClock(
+                      "b", clock=_fake_clock(500.0 + skew))}
+        records = []
+        in_flight = []
+        for _ in range(120):
+            op = rng.random()
+            src = rng.choice(["a", "b"])
+            dst = "b" if src == "a" else "a"
+            if op < 0.5:
+                sent = clocks[src].tick()
+                in_flight.append((dst, sent))
+                records.append({"kind": "msg.send", "ts": 0.0,
+                                "hlc": list(sent), "service": src,
+                                "verb": "DEPLOY"})
+            elif in_flight:
+                dst, sent = in_flight.pop(
+                    rng.randrange(len(in_flight)))
+                got = clocks[dst].observe(sent)
+                records.append({"kind": "msg.recv", "ts": 0.0,
+                                "hlc": list(got), "service": dst,
+                                "verb": "DEPLOY", "sent": list(sent)})
+        merged = merge_records(records)
+        assert causality_inversions(merged) == [], \
+            f"trial {trial} (skew {skew:+.1f}s)"
+
+
+def test_timeline_self_check_is_clean():
+    # the conftest session gate, callable directly
+    assert timeline_self_check() == []
+
+
+def test_causality_inversions_catches_a_broken_receive_rule():
+    # a receive stamped BELOW its send must be reported, not absorbed
+    bad = [{"kind": "msg.send", "ts": 0.0, "hlc": [10, 0, "a"],
+            "service": "a", "verb": "HEARTBEAT"},
+           {"kind": "msg.recv", "ts": 0.0, "hlc": [9, 0, "b"],
+            "service": "b", "verb": "HEARTBEAT",
+            "sent": [10, 0, "a"]}]
+    findings = causality_inversions(merge_records(bad))
+    assert findings
+    assert any(f["rule"] == "stamp" for f in findings)
+
+
+# --- timeline store + reader -------------------------------------------------
+
+
+def test_timeline_store_writes_and_reader_survives_torn_tail(tmp_path):
+    path = str(tmp_path / "timeline-a.jsonl")
+    tl = TimelineStore("a", path=path, clock=_fake_clock(10.0))
+    tl.record("epoch.seal", epoch=3)
+    tl.record("scale.decision", epoch=3, action="hold")
+    tl.close()
+    # a SIGKILL mid-append leaves a torn final line: dropped, not fatal
+    with open(path, "a") as f:
+        f.write('{"kind": "msg.send", "ts": 11.0, "hl')
+    recs = read_timeline(path)
+    assert [r["kind"] for r in recs] == ["epoch.seal", "scale.decision"]
+    assert recs[0]["service"] == "a" and recs[0]["epoch"] == 3
+
+
+def test_timeline_reader_refuses_mid_file_junk(tmp_path):
+    path = str(tmp_path / "timeline-junk.jsonl")
+    with open(path, "w") as f:
+        f.write('{"kind": "epoch.seal", "ts": 1.0, "epoch": 1}\n')
+        f.write("not json at all\n")
+        f.write('{"kind": "epoch.seal", "ts": 2.0, "epoch": 2}\n')
+    with pytest.raises(ValueError) as ei:
+        read_timeline(path)
+    assert "timeline-junk.jsonl" in str(ei.value)
+    assert "2" in str(ei.value)   # the offending line number
+
+
+def test_merge_and_diff_of_two_stores(tmp_path):
+    pa, pb = str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")
+    ta = TimelineStore("a", path=pa, clock=_fake_clock(5.0))
+    tb = TimelineStore("b", path=pb, clock=_fake_clock(900.0))
+    ta.record("epoch.seal", epoch=1)
+    tb.record("epoch.seal", epoch=1)
+    ta.record("chaos", chaos_kind="kill", at_s=1.0)
+    ta.close(), tb.close()
+    merged = merge_records(read_timeline(pa) + read_timeline(pb))
+    assert len(merged) == 3
+    # same logical content despite wildly different wall clocks
+    assert diff_timelines(
+        [r for r in read_timeline(pa) if r["kind"] == "epoch.seal"],
+        read_timeline(pb)) == []
+    # ...and the extra chaos record is attributed to the right side
+    findings = diff_timelines(read_timeline(pa), read_timeline(pb))
+    assert [f["only"] for f in findings] == ["a"]
+    assert findings[0]["record"]["kind"] == "chaos"
+
+
+# --- gray-failure detector (pure core) ---------------------------------------
+
+
+def _snap(epoch=1, hb=None, ep=None, stal=None, stall=0.0):
+    return GraySnapshot.build(
+        epoch=epoch, hb_age_ms=hb or {}, epoch_ms=ep or {},
+        staleness=stal or {}, fence_stall_ms=stall)
+
+
+def test_snapshot_canonical_crc_roundtrip():
+    s = _snap(epoch=7, hb={"w0": 0.0, "w3": 412.3},
+              ep={"w0": 100.0, "w3": 950.0}, stal={"replica.0": 0.5},
+              stall=133.7)
+    d = json.loads(s.canonical())
+    assert GraySnapshot.from_dict(d) == s
+    assert GraySnapshot.from_dict(d).crc() == s.crc()
+
+
+def test_detect_gray_is_deterministic():
+    cfg = DetectorConfig()
+    s = _snap(hb={"w0": 0.0, "w1": 0.0, "w3": 500.0})
+    v1, st1 = detect_gray(s, cfg, DetectorState())
+    v2, st2 = detect_gray(s, cfg, DetectorState())
+    assert v1 == v2 and st1 == st2 and v1.snapshot_crc == s.crc()
+
+
+def test_peer_relative_scoring_ignores_cluster_wide_slowdown():
+    cfg = DetectorConfig()
+    # everyone is equally slow: the median moves, nobody is an outlier
+    uniform = _snap(ep={f"w{i}": 5000.0 for i in range(4)})
+    assert score_gray(uniform, cfg) == {}
+    # one worker 4x the median IS an outlier
+    skewed = _snap(ep={"w0": 100.0, "w1": 100.0, "w2": 100.0,
+                       "w3": 400.0})
+    scores = score_gray(skewed, cfg)
+    assert list(scores) == ["w3"]
+    assert "epoch-outlier" in scores["w3"][1]
+
+
+def test_fence_stall_corroborates_but_never_accuses():
+    cfg = DetectorConfig()
+    # a stalled fence with no per-worker evidence names nobody
+    assert score_gray(_snap(stall=9000.0), cfg) == {}
+    # with a lagging worker, the stall strengthens that evidence
+    scores = score_gray(_snap(hb={"w0": 0.0, "w2": 400.0},
+                              stall=9000.0), cfg)
+    assert scores["w2"][0] == 2
+    assert scores["w2"][1] == ("hb-lag", "fence-stall")
+
+
+def test_suspicion_must_sustain_and_resets_on_recovery():
+    cfg = DetectorConfig(sustain_fences=2)
+    lagging = _snap(hb={"w0": 0.0, "w1": 300.0})
+    healthy = _snap(hb={"w0": 0.0, "w1": 0.0})
+    v, st = detect_gray(lagging, cfg, DetectorState())
+    assert v.suspects == ()          # one fence is noise
+    assert v.scores == (("w1", 1),)  # ...but the score is visible
+    v, st = detect_gray(lagging, cfg, st)
+    assert v.suspect_workers() == ["w1"]   # sustained: suspect
+    v, st = detect_gray(healthy, cfg, st)
+    assert v.suspects == ()          # recovered: streak resets
+    v, st = detect_gray(lagging, cfg, st)
+    assert v.suspects == ()          # must re-sustain from scratch
+
+
+def test_detector_replays_bit_identically_and_catches_tampering(
+        tmp_path):
+    configure_timeline("jm", path=str(tmp_path / "t.jsonl"),
+                       clock=_fake_clock(50.0))
+    det = GrayFailureDetector(DetectorConfig(sustain_fences=1))
+    det.on_fence(_snap(epoch=1, hb={"w0": 0.0, "w1": 300.0}))
+    det.on_fence(_snap(epoch=2, hb={"w0": 0.0, "w1": 280.0}))
+    det.on_fence(_snap(epoch=3, hb={"w0": 0.0, "w1": 0.0}))
+    assert det.suspects() == []              # cleared at fence 3
+    assert det.events_emitted >= 2           # suspect + cleared
+    verdicts = det.replay()                  # bit-identical from log
+    assert [v.epoch for v in verdicts] == [1, 2, 3]
+    assert verdicts[0].suspect_workers() == ["w1"]
+    # the timeline carries the suspect AND the clearance
+    kinds = [r["kind"] for r in read_timeline(str(tmp_path / "t.jsonl"))]
+    assert "health.gray-suspect" in kinds
+    assert "health.gray-cleared" in kinds
+    # tamper with a pinned snapshot: replay must refuse
+    det.log[1]["snapshot"]["hb_age_ms"][1][1] = 0.0
+    with pytest.raises(ValueError):
+        det.replay()
+
+
+def test_detector_gauges_ride_the_metric_rollup():
+    from clonos_tpu.utils.metrics import MetricRegistry
+    reg = MetricRegistry()
+    det = GrayFailureDetector(DetectorConfig(sustain_fences=1))
+    det.register_gauges(reg)
+    det.on_fence(_snap(epoch=1, hb={"w0": 0.0, "w1": 300.0}))
+    snap = reg.snapshot()
+    assert snap["cluster.health.suspects"] == 1
+    assert snap["cluster.health.gray-events"] == 1
+    assert snap["cluster.health.fences-scored"] == 1
+
+
+def test_top_renders_health_row_and_trace_drop_line():
+    from clonos_tpu.cli import _top_table
+    table = _top_table({"cluster.health.suspects": 1,
+                        "cluster.health.gray-events": 3,
+                        "trace.dropped-records": 42})
+    health = next(l for l in table.splitlines()
+                  if l.startswith("health:"))
+    assert "suspects=1" in health and "gray-events=3" in health
+    assert "dropped-records=42" in table
+    # zero drops: no alarm line
+    assert "dropped-records" not in _top_table(
+        {"cluster.health.suspects": 0, "trace.dropped-records": 0})
+
+
+def test_tracer_counts_ring_evictions():
+    from clonos_tpu.obs.trace import Tracer
+    tr = Tracer("t", clock=_fake_clock(1.0), buffer=4)
+    for i in range(7):
+        tr.event("e", i=i)
+    assert tr.dropped == 3
+    assert len(tr.records()) == 4
+    tr.close()
+
+
+# --- the CLI contract --------------------------------------------------------
+
+
+def test_timeline_cli_report_json_and_filters(tmp_path, capsys):
+    from clonos_tpu.cli import main
+    pa = str(tmp_path / "timeline-jm.jsonl")
+    tl = TimelineStore("jm", path=pa, clock=_fake_clock(5.0))
+    tl.record("epoch.seal", epoch=1)
+    tl.record("epoch.seal", epoch=2)
+    tl.record("scale.decision", epoch=2, action="hold")
+    tl.close()
+    rc = main(["timeline", pa, "--report", "json"])
+    rep = json.loads(capsys.readouterr().out)
+    assert rc == 0 and rep["ok"] is True
+    assert rep["records"] == 3 and rep["inversions"] == []
+    assert rep["by_kind"]["epoch.seal"] == 2
+    # filtered view: counts reflect the filter, inversions never do
+    rc = main(["timeline", pa, "--kind", "scale", "--report", "json"])
+    rep = json.loads(capsys.readouterr().out)
+    assert rc == 0 and rep["shown"] == 1
+
+
+def test_timeline_cli_self_check_and_diff_exit_codes(tmp_path, capsys):
+    from clonos_tpu.cli import main
+    assert main(["timeline", "--self-check"]) == 0
+    capsys.readouterr()
+    pa, pb = str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")
+    ta = TimelineStore("a", path=pa, clock=_fake_clock(1.0))
+    tb = TimelineStore("b", path=pb, clock=_fake_clock(2.0))
+    ta.record("epoch.seal", epoch=1)
+    tb.record("epoch.seal", epoch=1)
+    ta.close(), tb.close()
+    assert main(["timeline", pa, "--diff", pb]) == 0
+    capsys.readouterr()
+    tb2 = TimelineStore("b", path=pb, clock=_fake_clock(3.0))
+    tb2.record("epoch.seal", epoch=2)   # b diverges
+    tb2.close()
+    assert main(["timeline", pa, "--diff", pb]) == 1
+    capsys.readouterr()
+
+
+def test_timeline_cli_reports_inversions_with_exit_1(tmp_path, capsys):
+    from clonos_tpu.cli import main
+    path = str(tmp_path / "bad.jsonl")
+    with open(path, "w") as f:
+        f.write(json.dumps({"kind": "msg.send", "ts": 1.0,
+                            "hlc": [10, 0, "a"], "service": "a",
+                            "verb": "DEPLOY"}) + "\n")
+        f.write(json.dumps({"kind": "msg.recv", "ts": 2.0,
+                            "hlc": [9, 0, "b"], "service": "b",
+                            "verb": "DEPLOY",
+                            "sent": [10, 0, "a"]}) + "\n")
+    rc = main(["timeline", path, "--report", "json"])
+    rep = json.loads(capsys.readouterr().out)
+    assert rc == 1 and rep["ok"] is False and rep["inversions"]
+
+
+def test_timeline_cli_chrome_export(tmp_path, capsys):
+    from clonos_tpu.cli import main
+    pa = str(tmp_path / "t.jsonl")
+    tl = TimelineStore("jm", path=pa, clock=_fake_clock(5.0))
+    tl.record("epoch.seal", epoch=1)
+    tl.close()
+    out = str(tmp_path / "chrome.json")
+    assert main(["timeline", pa, "--chrome", out]) == 0
+    capsys.readouterr()
+    doc = json.load(open(out))
+    instants = [e for e in doc["traceEvents"] if e["ph"] == "i"]
+    assert instants and instants[0]["name"] == "epoch.seal"
+
+
+# --- acceptance: 2-process SIGKILL, one merged timeline ----------------------
+
+
+_CHILD = r"""
+import sys, time
+port, path = int(sys.argv[1]), sys.argv[2]
+from clonos_tpu.obs import configure_hlc, configure_timeline
+from clonos_tpu.parallel import transport as tp
+# the child's wall clock reads 45 SECONDS AHEAD of the parent's: raw
+# timestamps would order every parent-side receive far before its
+# send; only the HLC receive rule keeps the merged timeline causal
+import time as _t
+configure_hlc(node="child", clock=lambda: _t.time() + 45.0)
+configure_timeline("child", path=path)
+c = tp.ControlClient(("127.0.0.1", port), timeout_s=10.0)
+for i in range(100000):
+    msg = tp.attach_hlc({"seq": i}, verb="HEARTBEAT")
+    c.call_json(tp.HEARTBEAT, msg)
+    if i == 0:
+        print("ready", flush=True)
+    time.sleep(0.002)
+"""
+
+
+def test_sigkilled_child_merges_into_one_causal_timeline(tmp_path):
+    """A child process streams HLC-stamped heartbeats (with its wall
+    clock skewed +45s) until it is SIGKILLed mid-run. The parent's and
+    the orphaned child's timeline files must merge into ONE stream
+    with zero causality inversions — the dead process's last words
+    still land in causal order."""
+    from clonos_tpu.obs import configure_hlc
+    from clonos_tpu.parallel import transport as tp
+
+    parent_tl = str(tmp_path / "timeline-parent.jsonl")
+    child_tl = str(tmp_path / "timeline-child.jsonl")
+    configure_hlc(node="parent")
+    configure_timeline("parent", path=parent_tl)
+    seen = []
+
+    def handler(mtype, payload):
+        obj = tp.unpack_json(payload)
+        tp.adopt_hlc(obj, verb="HEARTBEAT")
+        seen.append(obj["seq"])
+        return mtype, tp.pack_json({"ok": True})
+
+    srv = tp.ControlServer(handler)
+    child_src = str(tmp_path / "child.py")
+    with open(child_src, "w") as f:
+        f.write(_CHILD)
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    pb = subprocess.Popen(
+        [sys.executable, child_src, str(srv.address[1]), child_tl],
+        cwd=REPO, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL, text=True)
+    try:
+        assert pb.stdout.readline().strip() == "ready"
+        deadline = time.monotonic() + 20.0
+        while len(seen) < 8 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert len(seen) >= 8, "child never delivered 8 heartbeats"
+        pb.send_signal(signal.SIGKILL)   # mid-loop, mid-write maybe
+        pb.wait(timeout=10.0)
+    finally:
+        if pb.poll() is None:
+            pb.kill()
+        srv.close()
+    assert pb.returncode == -signal.SIGKILL
+
+    merged = merge_records(read_timeline(parent_tl)
+                           + read_timeline(child_tl))
+    assert causality_inversions(merged) == []
+    sends = [r for r in merged if r["kind"] == "msg.send"]
+    recvs = [r for r in merged if r["kind"] == "msg.recv"]
+    assert len(sends) >= 8 and len(recvs) >= 8
+    assert len(recvs) <= len(sends)      # the kill can orphan sends
+    assert {r["service"] for r in sends} == {"child"}
+    assert {r["service"] for r in recvs} == {"parent"}
+    # despite the +45s skew, every recv sorts after its send; spot-
+    # check the interleave: the first record is a send
+    assert merged[0]["kind"] == "msg.send"
+
+
+# --- acceptance: gray soak — suspect BEFORE the first SLO breach -------------
+
+
+@pytest.mark.slow
+def test_gray_soak_suspect_fires_before_first_slo_breach(tmp_path):
+    """The end-to-end detection story: a paced soak takes a gray
+    failure (worker 3's beats lag 30ms, transport stretched) and the
+    detector must call it — ``health.gray-suspect`` lands in the
+    merged timeline BEFORE the first ``slo.breach``, the audit ledger
+    stays clean, and the whole detection sequence replays
+    bit-identically from the pinned snapshot log."""
+    from clonos_tpu.obs import configure_detector
+    from clonos_tpu.soak import (SLOSpec, SoakConfig, SoakDriver,
+                                 build_soak_fixture)
+
+    tl_path = str(tmp_path / "timeline-soak.jsonl")
+    configure_timeline("soak", path=tl_path)
+    # hb threshold under the 30ms injected lag; staleness channel
+    # silenced (complete_every=2 legitimately lets replicas trail)
+    configure_detector(DetectorConfig(
+        hb_age_high_ms=15.0, staleness_high=100.0, sustain_fences=1))
+    runner, control, election = build_soak_fixture(
+        str(tmp_path / "fx"), rate=1200.0, duration_s=3.5,
+        steps_per_epoch=32, seed=11)
+    driver = SoakDriver(
+        runner, SoakConfig(rate=1200.0, duration_s=3.5, window_s=1.0,
+                           chunk_steps=8),
+        schedule=parse_schedule("at 0.2s gray 3 delay=30ms for 60s"),
+        spec=SLOSpec(exactly_once=True, max_p99_ms=400.0),
+        control=control, election=election, records_per_step=16)
+    v = driver.run()
+
+    assert v["audit"]["exactly_once"] is True
+    assert v["audit"]["divergences"] == []
+    assert "w3" in v["health"]["suspects"]
+    assert v["health"]["replay_bit_identical"] is True
+    assert v["health"]["gray_events"] >= 1
+
+    merged = merge_records(read_timeline(tl_path))
+    assert causality_inversions(merged) == []
+    kinds = [r["kind"] for r in merged]
+    suspect_at = kinds.index("health.gray-suspect")
+    assert merged[suspect_at]["worker"] == "w3"
+    # the detector got there first: the suspect precedes every breach
+    # (the gray-stretched transport guarantees at least one)
+    assert "slo.breach" in kinds
+    assert suspect_at < kinds.index("slo.breach")
+    # the chaos event itself is on the same timeline, before the call
+    assert kinds.index("chaos") < suspect_at
