@@ -84,8 +84,13 @@ class DecisionLog:
         self.path = path
         self.rows: List[np.ndarray] = []
         self.records: List[Dict[str, Any]] = []   # {"signals":…, "decision":…}
-        if path is not None and os.path.exists(path):
-            self._load()
+        self._sidecar_writer = None
+        if path is not None:
+            from clonos_tpu.utils.jsonl import JsonlAppender
+            self._sidecar_writer = JsonlAppender(self.sidecar_path,
+                                                 sort_keys=True)
+            if os.path.exists(path):
+                self._load()
 
     @property
     def sidecar_path(self) -> Optional[str]:
@@ -121,8 +126,9 @@ class DecisionLog:
         if self.path is not None:
             with open(self.path, "ab") as f:
                 f.write(det.to_bytes(packed.reshape(1, -1)))
-            with open(self.sidecar_path, "a") as f:
-                f.write(json.dumps(rec, sort_keys=True) + "\n")
+            # Sidecar rides the shared durable appender (utils/jsonl):
+            # same flush-per-record policy as every other JSONL log.
+            self._sidecar_writer.append(rec)
 
     def determinants(self) -> List[det.ScaleDeterminant]:
         return [det.Determinant.unpack(r) for r in self.rows]
@@ -202,17 +208,28 @@ class AutoscaleController:
             logged = det.Determinant.unpack(row)
             sig = ScaleSignals.from_dict(rec["signals"])
             if sig.crc() != logged.signal_crc:
+                self._signal_conformance(i, "signal-crc-pin")
                 raise ValueError(
                     f"decision log entry {i}: signal sidecar fails its "
                     f"crc pin (crc {sig.crc():#x} != logged "
                     f"{logged.signal_crc:#x})")
             dec, st = self.policy.decide(sig, st)
             if not np.array_equal(decision_row(dec).pack(), row):
+                self._signal_conformance(i, "decision-replay")
                 raise ValueError(
                     f"decision log entry {i} does not replay "
                     f"bit-identically: policy now yields {dec}")
             self._logged_by_epoch[dec.epoch] = dec
         self.state = st
+
+    @staticmethod
+    def _signal_conformance(entry: int, check: str) -> None:
+        """Replay-not-re-decide broke: capture a bundle before the
+        raise tears the process down (no-op when disabled)."""
+        from clonos_tpu.obs.incident import get_incidents
+        get_incidents().signal("conformance.mismatch",
+                               source="decision-log-replay",
+                               entry=entry, check=check)
 
     # --- protocol steps (model-action aligned) -------------------------------
 

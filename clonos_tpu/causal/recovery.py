@@ -122,6 +122,14 @@ class AuditValidator:
                 channel, reason = d
                 tr.event("recovery.audit.divergence", epoch=e,
                          channel=channel, reason=reason)
+                # Flight-recorder trigger: the replayed epoch went
+                # off-script — bundle the evidence before the abort
+                # policy (possibly) tears recovery down. No-op when
+                # the incident plane is disabled.
+                from clonos_tpu.obs.incident import get_incidents
+                get_incidents().signal("audit.divergence", epoch=e,
+                                       channel=channel, reason=reason,
+                                       source="recovery-validator")
                 if self.on_divergence == "abort":
                     raise AuditDivergenceError(
                         f"epoch {e} channel {channel}: {reason} — replay "

@@ -693,6 +693,21 @@ def _top_table(snap) -> str:
         lines.append("")
         lines.append("health: " + "  ".join(
             f"{k}={v}" for k, v in sorted(health.items())))
+    # Incidents status row: the flight recorder's incident.* gauges
+    # (bundles captured, dedup/rate-limit drops, signals seen) — same
+    # suffix matching as soak:/serve:/health:.
+    incidents = {}
+    for k, v in sorted(snap.items()):
+        if not isinstance(v, (int, float)) or isinstance(v, bool):
+            continue
+        if k.startswith("incident."):
+            incidents[k[len("incident."):]] = v
+        elif ".incident." in k:
+            incidents.setdefault(k.rsplit(".incident.", 1)[1], v)
+    if incidents:
+        lines.append("")
+        lines.append("incidents: " + "  ".join(
+            f"{k}={v}" for k, v in sorted(incidents.items())))
     tenant = {k: v for k, v in sorted(snap.items())
               if (k.startswith("tenant.")
                   or k.startswith("dispatcher."))
@@ -1023,14 +1038,6 @@ def cmd_timeline(args) -> int:
         print("timeline: at least one timeline-*.jsonl file required "
               "(or --self-check)", file=sys.stderr)
         return 2
-    records = obs.read_timeline(args.files)
-    if args.trace:
-        records = records + obs.from_trace_records(
-            obs.load_jsonl(args.trace))
-    # inversions are checked over the FULL merged set — filters narrow
-    # what is shown, never what is proven
-    inversions = obs.causality_inversions(records)
-    merged = obs.merge_records(records)
 
     def _match(rec) -> bool:
         if args.kind and not str(rec.get("kind", "")).startswith(
@@ -1052,6 +1059,67 @@ def cmd_timeline(args) -> int:
                 return False
         return True
 
+    # The default and --report paths STREAM: a k-way heap merge over
+    # per-file cursors (obs.iter_merged) keeps memory O(open files),
+    # not O(total events) — a long soak's timelines merge flat.
+    # --trace/--diff/--chrome mix in unsorted sources or need the full
+    # set in hand, so they still materialize.
+    if not (args.trace or args.diff is not None or args.chrome):
+        # inversions are checked over the FULL merged stream — filters
+        # narrow what is shown, never what is proven
+        inversions = obs.causality_inversions_stream(
+            obs.iter_merged(args.files))
+        if inversions:
+            # A broken receive rule IS an incident: when a flight
+            # recorder is armed in this process, the first inversion
+            # lands a bundle (Null manager: no-op).
+            from clonos_tpu.obs.incident import get_incidents
+            get_incidents().signal(
+                "timeline.inversion", rule=inversions[0]["rule"],
+                detail=inversions[0]["detail"],
+                count=len(inversions))
+        if args.report == "json":
+            by_kind: dict = {}
+            total = shown_n = 0
+            for r in obs.iter_merged(args.files):
+                total += 1
+                if not _match(r):
+                    continue
+                shown_n += 1
+                k = str(r.get("kind", "?"))
+                by_kind[k] = by_kind.get(k, 0) + 1
+            print(json.dumps({"ok": not inversions, "records": total,
+                              "shown": shown_n,
+                              "by_kind": dict(sorted(by_kind.items())),
+                              "inversions": inversions}))
+            return 0 if not inversions else 1
+        for r in obs.iter_merged(args.files):
+            if not _match(r):
+                continue
+            hlc = r.get("hlc")
+            stamp = (f"{hlc[0]}.{hlc[1]}@{hlc[2]}" if hlc
+                     else f"~{r.get('ts', 0):.6f}")
+            extras = " ".join(
+                f"{k}={v}" for k, v in sorted(r.items())
+                if k not in ("kind", "ts", "hlc", "service", "pid"))
+            print(f"{stamp:<40} [{r.get('service')}] "
+                  f"{r.get('kind')} {extras}".rstrip())
+        if inversions:
+            print(f"\nCAUSALITY INVERSIONS: {len(inversions)}",
+                  file=sys.stderr)
+            for f in inversions:
+                print(f"  {f['rule']}: {f['detail']} "
+                      f"(verb={f.get('verb')})", file=sys.stderr)
+        return 0 if not inversions else 1
+
+    records = obs.read_timeline(args.files)
+    if args.trace:
+        records = records + obs.from_trace_records(
+            obs.load_jsonl(args.trace))
+    # inversions are checked over the FULL merged set — filters narrow
+    # what is shown, never what is proven
+    inversions = obs.causality_inversions(records)
+    merged = obs.merge_records(records)
     shown = [r for r in merged if _match(r)]
 
     if args.diff is not None:
@@ -1111,6 +1179,106 @@ def cmd_timeline(args) -> int:
     return 0 if not inversions else 1
 
 
+def cmd_incident(args) -> int:
+    """Incident forensics (``clonos_tpu incident``): list, dump and
+    root-cause-localize the flight-recorder bundles an IncidentManager
+    landed under ``<dir>/incidents/``. ``explain`` runs the pure
+    deterministic analyzer (obs/rootcause.py) — same bundle, same
+    bytes, in any process; ``--report json`` prints the canonical
+    one-line report and exits 0 (localized) / 1 (could not localize).
+    ``--self-check`` is the conftest gate: synthetic bundles through
+    the full pipeline, byte-identity enforced."""
+    from clonos_tpu.obs import incident as inc
+    from clonos_tpu.obs import rootcause as rc
+
+    if args.self_check:
+        findings = inc.incident_self_check()
+        print(json.dumps({"ok": not findings, "check": "incident-forensics",
+                          "schema": inc.bundle_schema_fingerprint(),
+                          "findings": findings}))
+        return 0 if not findings else 1
+
+    if args.action is None:
+        print("incident: an action (list|show|explain) or --self-check "
+              "is required", file=sys.stderr)
+        return 2
+
+    bdir = os.path.join(args.dir, "incidents")
+    if os.path.isdir(args.dir) and os.path.basename(
+            os.path.normpath(args.dir)) == "incidents":
+        bdir = args.dir            # already pointed at the bundle dir
+    try:
+        names = sorted(n for n in os.listdir(bdir)
+                       if n.startswith("incident-")
+                       and n.endswith(".json"))
+    except OSError:
+        names = []
+    paths = [os.path.join(bdir, n) for n in names]
+
+    if args.action == "list":
+        if not paths:
+            print(f"no incident bundles under {bdir}")
+            return 0
+        print(f"{'seq':>4}  {'kind':<20} {'epoch':>5}  "
+              f"{'fingerprint':<16} file")
+        for path in paths:
+            try:
+                b = inc.load_bundle(path)
+            except (OSError, ValueError):
+                print(f"  ??  {'<unreadable>':<20} {'':>5}  {'':<16} "
+                      f"{os.path.basename(path)}")
+                continue
+            info = b.get("bundle", {})
+            trig = b.get("trigger", {})
+            ep = trig.get("epoch")
+            print(f"{info.get('seq', 0):>4}  "
+                  f"{trig.get('kind', '?'):<20} "
+                  f"{'-' if ep is None else ep:>5}  "
+                  f"{info.get('fingerprint', '?'):<16} "
+                  f"{os.path.basename(path)}")
+        return 0
+
+    # show/explain take a bundle: a path, a seq number, or a substring
+    def _resolve(target):
+        if target is None:
+            return paths[-1] if paths else None   # newest
+        if os.path.isfile(target):
+            return target
+        if target.isdigit():
+            want = f"incident-{int(target):04d}-"
+            for path in paths:
+                if os.path.basename(path).startswith(want):
+                    return path
+        for path in paths:
+            if target in os.path.basename(path):
+                return path
+        return None
+
+    path = _resolve(args.bundle)
+    if path is None:
+        print(f"incident: no bundle matching "
+              f"{args.bundle!r} under {bdir}", file=sys.stderr)
+        return 2
+    try:
+        bundle = inc.load_bundle(path)
+    except (OSError, ValueError) as e:
+        print(f"incident: cannot read {path}: {e}", file=sys.stderr)
+        return 1
+
+    if args.action == "show":
+        print(json.dumps(bundle, indent=2, sort_keys=True))
+        return 0
+
+    report = rc.analyze_bundle(bundle)
+    ok = str(report.get("verdict", "")).startswith("localized")
+    if args.report == "json":
+        sys.stdout.write(rc.render_report(report))
+        return 0 if ok else 1
+    print(f"bundle: {path}")
+    print(rc.format_report(report))
+    return 0 if ok else 1
+
+
 def cmd_soak(args) -> int:
     """Open-loop soak run (``clonos_tpu soak``): paced load at a fixed
     ingestion rate, a seeded (or explicit) chaos schedule, windowed SLO
@@ -1133,6 +1301,13 @@ def cmd_soak(args) -> int:
         from clonos_tpu.obs import configure_detector
         configure_detector()
     workdir = args.workdir or tempfile.mkdtemp(prefix="clonos-soak-")
+    if args.incidents:
+        # Flight recorder: any failure signal during the soak (audit
+        # divergence, SLO breach, gray suspect, conformance mismatch)
+        # lands a durable forensic bundle under <workdir>/incidents/;
+        # `clonos_tpu incident explain` localizes it afterwards.
+        from clonos_tpu.obs import configure_incidents
+        configure_incidents(workdir, service="soak")
     runner, control, election = build_soak_fixture(
         workdir, rate=args.rate, duration_s=args.duration,
         steps_per_epoch=args.steps_per_epoch, par=args.parallelism,
@@ -1228,6 +1403,9 @@ def cmd_soak(args) -> int:
             hl = verdict["health"]
             line["gray_suspects"] = hl["suspects"]
             line["gray_replay_ok"] = hl["replay_bit_identical"]
+        if args.incidents:
+            from clonos_tpu.obs.incident import get_incidents
+            line["incidents"] = get_incidents().captured
         print(json.dumps(line))
         return rc
     lat = verdict["latency"]
@@ -1265,6 +1443,13 @@ def cmd_soak(args) -> int:
     for w in verdict["windows"]:
         for b in w["breaches"]:
             print(f"  window {w['window']} breach: {b}")
+    if args.incidents:
+        from clonos_tpu.obs.incident import get_incidents
+        mgr = get_incidents()
+        if mgr.captured:
+            print(f"incidents: {mgr.captured} bundle(s) under "
+                  f"{mgr.dir} — `clonos_tpu incident explain "
+                  f"--dir {workdir}`")
     print(f"artifact: {out_path}")
     return rc
 
@@ -1550,6 +1735,30 @@ def main(argv=None) -> int:
                          "files (the conftest gate)")
     pm.set_defaults(fn=cmd_timeline)
 
+    pn = sub.add_parser("incident",
+                        help="list / show / root-cause-explain the "
+                             "flight-recorder bundles an incident "
+                             "manager landed")
+    pn.add_argument("action", nargs="?",
+                    choices=["list", "show", "explain"],
+                    help="list bundles, dump one, or run the "
+                         "deterministic root-cause analyzer on one")
+    pn.add_argument("bundle", nargs="?", default=None,
+                    help="bundle selector for show/explain: a path, a "
+                         "seq number, or a filename substring "
+                         "(default: the newest bundle)")
+    pn.add_argument("--dir", default=".",
+                    help="run workdir holding incidents/ (or the "
+                         "incidents/ dir itself); default cwd")
+    pn.add_argument("--report", choices=["json"], default=None,
+                    help="explain: one canonical JSON line (byte-"
+                         "identical across processes); exit 0 "
+                         "localized / 1 not")
+    pn.add_argument("--self-check", action="store_true",
+                    help="run the deterministic forensics self-check "
+                         "on synthetic bundles (no files); exit 0/1")
+    pn.set_defaults(fn=cmd_incident)
+
     pa = sub.add_parser("audit", help="print or diff a job's epoch "
                                       "audit ledger")
     pa.add_argument("dir", help="checkpoint dir (or slot-pool "
@@ -1651,6 +1860,13 @@ def main(argv=None) -> int:
                          "/ gray suspicion, HLC-stamped) to "
                          "timeline-soak.jsonl here (off by default: "
                          "zero overhead)")
+    pk.add_argument("--incidents", action="store_true",
+                    help="arm the incident flight recorder: failure "
+                         "signals (audit divergence, SLO breach, gray "
+                         "suspect, conformance mismatch) land durable "
+                         "forensic bundles under <workdir>/incidents/ "
+                         "for `clonos_tpu incident explain` (off by "
+                         "default: zero overhead, zero wire fields)")
     pk.add_argument("--detect-gray", action="store_true",
                     help="score the gray-failure detector at every "
                          "completed fence (cluster.health.* gauges, "

@@ -26,14 +26,23 @@ from .history import MetricsHistory, read_history_file  # noqa: F401
 from .hlc import (HybridLogicalClock, NullHLC, configure_hlc,  # noqa: F401
                   get_hlc, reset_hlc, stamp_key)
 from .timeline import (NullTimeline, TimelineStore,  # noqa: F401
-                       causality_inversions, configure_timeline,
+                       causality_inversions,
+                       causality_inversions_stream, configure_timeline,
                        diff_timelines, from_trace_records, get_timeline,
-                       merge_records, read_timeline, reset_timeline,
-                       timeline_self_check, to_trace_records)
+                       iter_merged, merge_records, read_timeline,
+                       reset_timeline, timeline_self_check,
+                       to_trace_records)
 from .detect import (DetectorConfig, DetectorState,  # noqa: F401
                      GrayFailureDetector, GraySnapshot, GrayVerdict,
                      NullDetector, configure_detector, detect_gray,
                      get_detector, reset_detector, score_gray)
+from .incident import (IncidentManager, NullIncidentManager,  # noqa: F401
+                       bundle_fingerprint, bundle_schema_fingerprint,
+                       capture_epoch_window, configure_incidents,
+                       get_incidents, incident_self_check, load_bundle,
+                       reset_incidents, summarize_window)
+from .rootcause import (RootCauseAnalyzer, analyze_bundle,  # noqa: F401
+                        format_report, render_report)
 
 __all__ = ["Tracer", "NullTracer", "get_tracer", "configure", "reset",
            "load_jsonl", "to_chrome", "validate_chrome", "summarize",
@@ -53,4 +62,12 @@ __all__ = ["Tracer", "NullTracer", "get_tracer", "configure", "reset",
            "GraySnapshot", "GrayVerdict", "DetectorConfig",
            "DetectorState", "GrayFailureDetector", "NullDetector",
            "detect_gray", "score_gray", "get_detector",
-           "configure_detector", "reset_detector"]
+           "configure_detector", "reset_detector",
+           "iter_merged", "causality_inversions_stream",
+           "IncidentManager", "NullIncidentManager", "get_incidents",
+           "configure_incidents", "reset_incidents", "load_bundle",
+           "bundle_fingerprint", "bundle_schema_fingerprint",
+           "capture_epoch_window", "summarize_window",
+           "incident_self_check",
+           "RootCauseAnalyzer", "analyze_bundle", "render_report",
+           "format_report"]

@@ -251,6 +251,14 @@ class GrayFailureDetector:
                           epoch=snap.epoch, score=now[w][0],
                           reasons=now[w][1],
                           snapshot_crc=snap.crc())
+            # A sustained suspect is a confirmed failure signal: hand
+            # the flight recorder a bundle trigger (no-op when the
+            # incident plane is disabled).
+            from clonos_tpu.obs.incident import get_incidents
+            get_incidents().signal(
+                "health.gray-suspect", epoch=snap.epoch, worker=w,
+                score=now[w][0], reasons=now[w][1],
+                snapshot_crc=snap.crc())
         for w in sorted(set(self._current) - set(now)):
             self.events_emitted += 1
             if tl.enabled:
@@ -276,6 +284,10 @@ class GrayFailureDetector:
                     f"pin ({snap.crc():#x} != {rec['crc']:#x})")
             v, st = detect_gray(snap, self.cfg, st)
             if v.to_dict() != rec["verdict"]:
+                from clonos_tpu.obs.incident import get_incidents
+                get_incidents().signal(
+                    "conformance.mismatch", epoch=snap.epoch,
+                    source="detector-replay", entry=i)
                 raise ValueError(
                     f"detector log entry {i} does not replay "
                     f"bit-identically: {v.to_dict()}")

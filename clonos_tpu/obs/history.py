@@ -26,8 +26,6 @@ safe from a thread while the main loop dispatches programs.
 from __future__ import annotations
 
 import collections
-import json
-import os
 import threading
 import time
 from typing import Any, Callable, Deque, Dict, List, Optional
@@ -61,9 +59,11 @@ class MetricsHistory:
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        self._file = None
+        self._writer = None
         self._file_lines = 0
         if path is not None:
+            from clonos_tpu.utils.jsonl import JsonlAppender
+            self._writer = JsonlAppender(path, default=str)
             # A restarted process resumes its ring from the surviving
             # file tail (torn final line tolerated).
             for rec in read_history_file(path)[-self.window:]:
@@ -81,31 +81,21 @@ class MetricsHistory:
         rec = {"ts": self._clock(), "metrics": metrics}
         with self._lock:
             self._ring.append(rec)
-            if self._path is not None:
-                if self._file is None:
-                    self._file = open(self._path, "a")
-                self._file.write(json.dumps(rec, default=str) + "\n")
-                self._file.flush()
+            if self._writer is not None:
+                self._writer.append(rec)
                 self._file_lines += 1
                 if self._file_lines > 2 * self.window:
                     self._compact_locked()
         return rec
 
     def _compact_locked(self) -> None:
-        # Atomic rewrite from the ring: the file never exceeds
-        # 2*window lines for long, and a crash mid-compaction leaves
-        # either the old file or the new one, never a mix.
-        if self._file is not None:
-            self._file.close()
-            self._file = None
-        tmp = self._path + ".tmp"
-        with open(tmp, "w") as f:
-            for rec in self._ring:
-                f.write(json.dumps(rec, default=str) + "\n")
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, self._path)
-        self._file_lines = len(self._ring)
+        # Atomic rewrite from the ring (utils/jsonl): the file never
+        # exceeds 2*window lines for long, and a crash mid-compaction
+        # leaves either the old file or the new one, never a mix.
+        from clonos_tpu.utils.jsonl import atomic_rewrite_jsonl
+        self._writer.close()     # os.replace swaps the inode under us
+        self._file_lines = atomic_rewrite_jsonl(
+            self._path, list(self._ring), default=str)
 
     def _loop(self) -> None:
         # Absolute-deadline pacing: ``wait(interval)`` THEN sample would
@@ -156,6 +146,5 @@ class MetricsHistory:
             self._thread.join(timeout=5)
             self._thread = None
         with self._lock:
-            if self._file is not None:
-                self._file.close()
-                self._file = None
+            if self._writer is not None:
+                self._writer.close()

@@ -78,10 +78,12 @@ class TimelineStore:
                  # clonos: allow(wallclock): record timestamps are
                  # observability metadata, never operator state.
                  clock=time.time, buffer: int = 8192):
+        from clonos_tpu.utils.jsonl import JsonlAppender
         self.service = service
         self._path = path
         self._clock = clock
-        self._file = None
+        self._writer = (JsonlAppender(path, default=str)
+                        if path is not None else None)
         self._lock = threading.Lock()
         self._ring: Deque[dict] = collections.deque(maxlen=buffer)
         # clonos: allow(entropy): pid tags records, never replayed data
@@ -101,11 +103,8 @@ class TimelineStore:
                 rec[k] = v
         with self._lock:
             self._ring.append(rec)
-            if self._path is not None:
-                if self._file is None:
-                    self._file = open(self._path, "a")
-                self._file.write(json.dumps(rec, default=str) + "\n")
-                self._file.flush()
+            if self._writer is not None:
+                self._writer.append(rec)
 
     def records(self) -> List[dict]:
         with self._lock:
@@ -113,9 +112,8 @@ class TimelineStore:
 
     def close(self) -> None:
         with self._lock:
-            if self._file is not None:
-                self._file.close()
-                self._file = None
+            if self._writer is not None:
+                self._writer.close()
 
 
 # --- process-global store ----------------------------------------------------
@@ -183,6 +181,64 @@ def merge_records(records: Sequence[dict]) -> List[dict]:
     """One HLC-ordered timeline from any number of processes' records
     (a stable sort: same-stamp records keep their input order)."""
     return sorted(records, key=record_key)
+
+
+def iter_merged(paths):
+    """Stream the HLC-merged timeline of many per-process files with
+    **O(open files)** memory: a k-way ``heapq.merge`` over per-file
+    streaming cursors (utils/jsonl.iter_jsonl, torn tails dropped).
+    Sound because each per-process file is appended in stamp order —
+    the process HLC only ticks forward, and the unstamped fallback key
+    (record ``ts``) is the same monotone append clock — so every
+    cursor is already sorted by :func:`record_key`. Merging a long
+    soak's files stays flat in memory instead of O(total events)."""
+    import heapq
+    from clonos_tpu.utils.jsonl import iter_jsonl
+    if isinstance(paths, (str, bytes)):
+        paths = [paths]
+    cursors = [iter_jsonl(str(p), label=str(p)) for p in paths]
+    return heapq.merge(*cursors, key=record_key)
+
+
+def causality_inversions_stream(merged) -> List[dict]:
+    """:func:`causality_inversions` over an already-merged streaming
+    iterator (:func:`iter_merged`), single pass: memory is the live
+    send-stamp set plus receives still awaiting their send — stamp
+    keys, not records. A recv seen before its send in merged order is
+    a merge inversion; a recv whose send never appears at all (file
+    not collected) is not."""
+    findings: List[dict] = []
+    open_sends: set = set()
+    pending: Dict[Tuple[int, int, str], dict] = {}
+    for rec in merged:
+        kind = rec.get("kind")
+        if kind == "msg.send" and rec.get("hlc"):
+            k = stamp_key(rec["hlc"])
+            open_sends.add(k)
+            recv = pending.pop(k, None)
+            if recv is not None:
+                findings.append(
+                    {"rule": "merge", "recv": recv.get("hlc"),
+                     "sent": recv.get("sent"),
+                     "verb": recv.get("verb"),
+                     "detail": "merged order lays the receive out "
+                               "before its send"})
+            continue
+        if kind != "msg.recv":
+            continue
+        sent, own = rec.get("sent"), rec.get("hlc")
+        if not sent or not own:
+            continue
+        sent_k, own_k = stamp_key(sent), stamp_key(own)
+        if own_k <= sent_k:
+            findings.append({"rule": "stamp", "recv": own,
+                             "sent": sent, "verb": rec.get("verb"),
+                             "detail": "receive stamp does not order "
+                                       "after its send stamp"})
+        if sent_k not in open_sends:
+            pending[sent_k] = {"hlc": own, "sent": sent,
+                               "verb": rec.get("verb")}
+    return findings
 
 
 def from_trace_records(trace_records: Sequence[dict]) -> List[dict]:

@@ -167,8 +167,7 @@ class FileCheckpointStorage(CheckpointStorage):
     def __init__(self, root: str):
         self.root = root
         os.makedirs(root, exist_ok=True)
-        self._ledger_f = None         # persistent append handle
-        self._ledger_unsynced = 0     # appends since the last fsync
+        self._ledger_w = None         # lazy shared durable appender
 
     def _path(self, cid: int) -> str:
         return os.path.join(self.root, f"chk_{cid}.pkl")
@@ -202,44 +201,49 @@ class FileCheckpointStorage(CheckpointStorage):
         return os.path.join(self.root, "ledger.jsonl")
 
     def write_ledger(self, entry: dict) -> None:
-        """Append one JSON line per sealed epoch, group-committed:
-        every line is flushed to the OS immediately (a clean process
-        exit loses nothing), but the fsync is batched every
+        """Append one JSON line per sealed epoch, group-committed
+        through the shared durable appender (utils/jsonl): every line
+        is flushed to the OS immediately (a clean process exit loses
+        nothing), but the fsync is batched every
         ``ledger_group_commit`` appends — per-entry fsync was the
         dominant fence-tail cost. Completion calls :meth:`flush_ledger`
         so a durable checkpoint never outruns its sealed entries; a
         SIGKILL inside the batch window loses at most the unsynced tail
         lines, which the tolerant reader already handles."""
-        import json
-        if self._ledger_f is None:
-            self._ledger_f = open(self.ledger_path(), "a")
-        self._ledger_f.write(json.dumps(entry, sort_keys=True) + "\n")
-        self._ledger_f.flush()
-        self._ledger_unsynced += 1
-        if self._ledger_unsynced >= self.ledger_group_commit:
-            os.fsync(self._ledger_f.fileno())
-            self._ledger_unsynced = 0
+        if self._ledger_w is None:
+            from clonos_tpu.utils.jsonl import JsonlAppender
+            self._ledger_w = JsonlAppender(
+                self.ledger_path(), sort_keys=True,
+                fsync_every=self.ledger_group_commit)
+        self._ledger_w.append(entry)
+
+    @property
+    def _ledger_unsynced(self) -> int:
+        """Sealed-but-unsynced tail lines in the group-commit window
+        (0 with no appender open) — the crash-exposure gauge the
+        torn-tail tests pin."""
+        return self._ledger_w.unsynced if self._ledger_w is not None \
+            else 0
 
     def flush_ledger(self) -> None:
-        if self._ledger_f is not None and self._ledger_unsynced:
-            os.fsync(self._ledger_f.fileno())
-            self._ledger_unsynced = 0
+        if self._ledger_w is not None:
+            self._ledger_w.sync()
 
     def _close_ledger(self) -> None:
-        if self._ledger_f is not None:
-            self.flush_ledger()
-            self._ledger_f.close()
-            self._ledger_f = None
+        if self._ledger_w is not None:
+            self._ledger_w.close()
+            self._ledger_w = None
 
     def read_ledger(self) -> List[dict]:
         return read_ledger_file(self.ledger_path())
 
     def compact_ledger(self, below_epoch: int) -> int:
         """Atomic last-wins rewrite of ledger.jsonl entries below the
-        fence (tmp + ``os.replace``: a crash mid-compaction leaves the
-        old file or the new one, never a mix). Torn final lines are
-        dropped by the tolerant read, which is also a compaction."""
-        import json
+        fence (utils/jsonl atomic_rewrite_jsonl: a crash mid-compaction
+        leaves the old file or the new one, never a mix). Torn final
+        lines are dropped by the tolerant read, which is also a
+        compaction."""
+        from clonos_tpu.utils.jsonl import atomic_rewrite_jsonl
         path = self.ledger_path()
         self._close_ledger()     # os.replace swaps the inode under us
         entries = read_ledger_file(path)
@@ -249,13 +253,7 @@ class FileCheckpointStorage(CheckpointStorage):
         dropped = len(entries) - len(compacted)
         if dropped == 0:
             return 0
-        tmp = path + ".tmp"
-        with open(tmp, "w") as f:
-            for e in compacted:
-                f.write(json.dumps(e, sort_keys=True) + "\n")
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, path)
+        atomic_rewrite_jsonl(path, compacted, sort_keys=True)
         return dropped
 
 

@@ -426,6 +426,14 @@ class ClusterRunner:
             self.profiler.bind(g)
         g.gauge("audit.enabled", lambda: int(self.auditor.enabled))
         g.gauge("audit.last-sealed-epoch", lambda: self.auditor.last_epoch)
+        # Incident forensics plane (obs/incident.py): when the process
+        # has a live IncidentManager its capture counters ride the same
+        # heartbeat piggyback; the NullIncidentManager default registers
+        # nothing — zero wire fields.
+        from clonos_tpu.obs import incident as _inc_mod
+        _inc = _inc_mod.get_incidents()
+        if _inc.enabled:
+            _inc.register_gauges(self.metrics)
         # Live exactly-once health: how hard the in-flight rings are
         # holding un-truncated history (backpressure proxy — rings only
         # grow when checkpoints lag), and how many supersteps a failure
@@ -2101,6 +2109,33 @@ class ClusterRunner:
                 overlap_finalize: Optional[bool] = None,
                 pre_patch_join: Optional[Callable[[], None]] = None
                 ) -> RecoveryReport:
+        """Public entry for :meth:`_recover_impl` that additionally
+        lands an incident bundle (obs/incident.py) when the protocol
+        itself fails — a recovery that cannot complete is exactly the
+        moment the forensic state (ledgers, determinant windows, HLC
+        timeline) is about to become unreachable. No-op passthrough
+        when the incident plane is disabled."""
+        try:
+            return self._recover_impl(
+                drill=drill, host_rows=host_rows,
+                overlap_finalize=overlap_finalize,
+                pre_patch_join=pre_patch_join)
+        except Exception as e:
+            from clonos_tpu.obs.incident import get_incidents
+            get_incidents().signal(
+                "recovery.failure",
+                epoch=int(getattr(self.auditor, "last_epoch", -1)),
+                error=f"{type(e).__name__}: {str(e)[:200]}",
+                drill=bool(drill),
+                failed=sorted(self.failed))
+            raise
+
+    def _recover_impl(self, drill: bool = False,
+                      host_rows: Optional[Dict[int, Tuple[np.ndarray, int]]]
+                      = None,
+                      overlap_finalize: Optional[bool] = None,
+                      pre_patch_join: Optional[Callable[[], None]] = None
+                      ) -> RecoveryReport:
         """Run the full causal-recovery protocol for all failed subtasks,
         in topological order (an upstream's reconstructed ring shard feeds
         its downstream's replay — the reference's staged

@@ -471,6 +471,15 @@ class Dispatcher:
             rec.state = state
             if error:
                 rec.error = error
+        if state == "FAILED":
+            # Terminal job failure is an incident trigger: the bundle
+            # lands before the job's forensic context (scheduler,
+            # tracer) is garbage-collected. No-op when the incident
+            # plane is disabled.
+            from clonos_tpu.obs.incident import get_incidents
+            get_incidents().signal(
+                "job.failure", job_id=rec.job_id, tenant=rec.tenant,
+                error=(error or "")[:200])
 
     def _deploy_ready(self) -> bool:
         with self._lock:

@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import re
 import time as _time
 from typing import Any, Dict, List, Optional
 
@@ -456,6 +457,15 @@ class SoakHarness:
             if p not in self.divergences:
                 self.divergences.append(p)
                 self.tracer.event("soak.audit.divergence", problem=p)
+                # Capture the flight-recorder bundle while both
+                # ledgers + determinant windows are still in hand
+                # (no-op when the incident plane is disabled).
+                from clonos_tpu.obs.incident import get_incidents
+                m = re.match(r"epoch (\d+)", p)
+                get_incidents().signal(
+                    "audit.divergence",
+                    epoch=int(m.group(1)) if m else None,
+                    problem=p)
         return problems
 
 
@@ -536,6 +546,51 @@ class SoakDriver:
                     not self.runner.heartbeats.expired()
                     and not self.runner.fence_tail_in_flight()))
         self._register_gauges()
+        self._attach_incident_providers()
+
+    def _attach_incident_providers(self) -> None:
+        """Hand the flight recorder (obs/incident.py) the soak run's
+        evidence sources: both ledgers (runner vs control twin), both
+        determinant windows, the chaos schedule, the decision log, the
+        cluster metrics rollup, and the run config. Providers are
+        closures over live objects — the manager snapshots through
+        them only at capture time, so the enabled-but-quiet cost is
+        zero; when the plane is disabled this attaches nothing at
+        all."""
+        from clonos_tpu.obs.incident import (capture_epoch_window,
+                                             get_incidents)
+        mgr = get_incidents()
+        if not mgr.enabled:
+            return
+
+        def ledgers():
+            out = {"actual": list(self.runner.auditor.ledger())}
+            if self.harness.control is not None:
+                out["expected"] = list(
+                    self.harness.control.auditor.ledger())
+            return out
+
+        def det_window(epoch):
+            out = {"actual": capture_epoch_window(
+                self.runner.executor, epoch)}
+            if self.harness.control is not None:
+                out["expected"] = capture_epoch_window(
+                    self.harness.control.executor, epoch)
+            return out
+
+        mgr.attach(
+            ledgers=ledgers,
+            det_window=det_window,
+            chaos=lambda: self.schedule.to_text(),
+            metrics=lambda: [{"metrics": self.runner.metrics.snapshot()}],
+            decisions=lambda: (list(self.autoscaler.log.records)
+                               if self.autoscaler is not None else []),
+            config=lambda: {"rate": self.cfg.rate,
+                            "duration_s": self.cfg.duration_s,
+                            "window_s": self.cfg.window_s,
+                            "chunk_steps": self.cfg.chunk_steps},
+        )
+        mgr.register_gauges(self.runner.metrics)
 
     def _register_gauges(self) -> None:
         g = self.runner.metrics.group("soak")

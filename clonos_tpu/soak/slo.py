@@ -142,6 +142,12 @@ class SLOTracker:
             if tl.enabled:
                 for b in breaches:
                     tl.record("slo.breach", window=win.index, breach=b)
+            # One incident signal per breached window (not per breach
+            # line — the window is the fault, the lines are symptoms);
+            # no-op when the incident plane is disabled.
+            from clonos_tpu.obs.incident import get_incidents
+            get_incidents().signal("slo.breach", window=win.index,
+                                   breaches=sorted(breaches))
         self.closed.append(win)
 
     def observe(self, now_s: float, corrected_ms: float,

@@ -115,6 +115,38 @@ def pytest_configure(config):
             "HLC causality self-check failed (clonos_tpu timeline "
             "--self-check): " + "; ".join(
                 f"[{f['rule']}] {f['detail']}" for f in findings))
+    # Incident forensics gate (clonos_tpu incident --self-check):
+    # synthetic bundles through capture → root-cause localization,
+    # byte-identity enforced across a JSON round-trip. Pure and
+    # jax-free — a drifting report encoding fails the session here,
+    # not in a post-mortem.
+    from clonos_tpu.obs.incident import (bundle_schema_fingerprint,
+                                         incident_self_check)
+    ifindings = incident_self_check()
+    if ifindings:
+        raise pytest.UsageError(
+            "incident forensics self-check failed (clonos_tpu "
+            "incident --self-check): " + "; ".join(
+                f"[{f['rule']}] {f['detail']}" for f in ifindings))
+    # Bundle-schema drift gate: landed bundles are durable post-mortem
+    # artifacts — the schema changing silently orphans every bundle
+    # already on disk. The pinned fingerprint must match.
+    ipin_path = os.path.join(_REPO_ROOT, ".clonos-incident-schema")
+    if os.path.isfile(ipin_path):
+        with open(ipin_path) as f:
+            toks = f.read().split()
+        pinned = toks[0] if toks else ""
+        fp = bundle_schema_fingerprint()
+        if fp != pinned:
+            raise pytest.UsageError(
+                f"incident bundle-schema drift: fingerprint {fp} != "
+                f"pinned {pinned} (.clonos-incident-schema) — the "
+                f"bundle layout changed; bump BUNDLE_SCHEMA's version "
+                f"(obs/incident.py) so old bundles stay decodable, "
+                f"then re-pin with\n  python -c \"from clonos_tpu.obs."
+                f"incident import bundle_schema_fingerprint; "
+                f"print(bundle_schema_fingerprint())\" "
+                f"> .clonos-incident-schema")
 
 
 @pytest.fixture

@@ -1,0 +1,428 @@
+"""Incident forensics plane (clonos_tpu/obs/incident.py + rootcause.py).
+
+Unit layers first — the shared JSONL substrate (appender, torn-tail
+tolerant reader, atomic rewrite) that every durable log in the repo now
+rides, the streaming k-way timeline merge (byte-equal to the
+materializing merge it replaced), and the flight recorder's capture
+discipline: bundles land atomically, deduplicate by trigger
+fingerprint, rate-limit per kind, cap at max_bundles, and a restarted
+manager resumes numbering + dedup from the bundles on disk. The
+root-cause analyzer is pure — the byte-identity test runs ``incident
+explain --report json`` in two fresh interpreter processes and demands
+identical bytes. The slow test is the end-to-end acceptance: an
+unlogged nondet perturbation (the examples/audit_nondet.py class)
+injected under a live soak must auto-capture a bundle whose
+localization names the salted ring channel, the first divergent
+determinant step, and the injecting worker.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from clonos_tpu.obs import incident as inc
+from clonos_tpu.obs import rootcause as rc
+from clonos_tpu.obs.hlc import reset_hlc
+from clonos_tpu.obs.timeline import (causality_inversions,
+                                     causality_inversions_stream,
+                                     configure_timeline, get_timeline,
+                                     iter_merged, merge_records,
+                                     read_timeline, reset_timeline)
+from clonos_tpu.utils.jsonl import (JsonlAppender, atomic_rewrite_jsonl,
+                                    iter_jsonl)
+from clonos_tpu.utils.metrics import MetricRegistry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _reset_globals():
+    yield
+    inc.reset_incidents()
+    reset_timeline()
+    reset_hlc()
+
+
+# --- JSONL substrate ---------------------------------------------------------
+
+
+def test_jsonl_appender_roundtrip(tmp_path):
+    path = str(tmp_path / "a.jsonl")
+    w = JsonlAppender(path, sort_keys=True)
+    w.append({"b": 2, "a": 1})
+    w.append({"x": [1, 2]})
+    w.sync()
+    w.close()
+    assert w.appended == 2
+    rows = list(iter_jsonl(path, "test"))
+    assert rows == [{"a": 1, "b": 2}, {"x": [1, 2]}]
+    # sort_keys really landed on disk (deterministic ledger encoding)
+    with open(path) as f:
+        assert f.readline().startswith('{"a"')
+
+
+def test_iter_jsonl_tolerates_torn_tail_only(tmp_path):
+    path = str(tmp_path / "t.jsonl")
+    with open(path, "w") as f:
+        f.write('{"ok": 1}\n{"torn": ')   # crash mid-append
+    assert list(iter_jsonl(path, "test")) == [{"ok": 1}]
+    # mid-file corruption (valid data AFTER the bad line) must raise —
+    # that is not a torn tail, it is a damaged file
+    with open(path, "w") as f:
+        f.write('{"ok": 1}\nGARBAGE\n{"ok": 2}\n')
+    with pytest.raises(ValueError):
+        list(iter_jsonl(path, "test"))
+
+
+def test_atomic_rewrite_jsonl(tmp_path):
+    path = str(tmp_path / "r.jsonl")
+    with open(path, "w") as f:
+        f.write('{"old": 1}\n' * 5)
+    n = atomic_rewrite_jsonl(path, [{"new": i} for i in range(3)])
+    assert n == 3
+    assert [r["new"] for r in iter_jsonl(path, "test")] == [0, 1, 2]
+    assert not os.path.exists(path + ".tmp")
+
+
+def _write_timeline(path, service, stamps):
+    w = JsonlAppender(str(path), default=str)
+    for i, (l_us, c) in enumerate(stamps):
+        w.append({"kind": f"k{i}", "ts": 0.0, "hlc": [l_us, c, service],
+                  "service": service, "pid": 1})
+    w.close()
+
+
+def test_iter_merged_matches_materialized_merge(tmp_path):
+    a, b = tmp_path / "ta.jsonl", tmp_path / "tb.jsonl"
+    _write_timeline(a, "a", [(10, 0), (30, 0), (30, 2)])
+    _write_timeline(b, "b", [(20, 0), (30, 1), (40, 0)])
+    paths = [str(a), str(b)]
+    streamed = list(iter_merged(paths))
+    batch = merge_records(read_timeline(paths))
+    assert streamed == batch
+    assert [r["hlc"][0] for r in streamed] == [10, 20, 30, 30, 30, 40]
+
+
+def test_causality_inversions_stream_matches_batch(tmp_path):
+    # one clean exchange + one inversion: the recv's HLC is NOT past
+    # the send's (a broken receive rule)
+    recs = [
+        {"kind": "msg.send", "ts": 0.0, "hlc": [10, 0, "a"],
+         "service": "a", "pid": 1},
+        {"kind": "msg.recv", "ts": 0.0, "hlc": [11, 0, "b"],
+         "service": "b", "pid": 2, "sent": [10, 0, "a"]},
+        {"kind": "msg.send", "ts": 0.0, "hlc": [20, 0, "a"],
+         "service": "a", "pid": 1},
+        {"kind": "msg.recv", "ts": 0.0, "hlc": [15, 0, "b"],
+         "service": "b", "pid": 2, "sent": [20, 0, "a"]},
+    ]
+    merged = merge_records(recs)
+    batch = causality_inversions(merged)
+    streamed = causality_inversions_stream(iter(merged))
+    assert batch and streamed
+    assert len(batch) == len(streamed)
+    assert {f["rule"] for f in streamed} == {f["rule"] for f in batch} \
+        == {"stamp", "merge"}
+
+
+def test_cli_timeline_streaming_report_counts_inversions(tmp_path):
+    a = tmp_path / "timeline-a.jsonl"
+    _write_timeline(a, "a", [(10, 0), (20, 0)])
+    out = subprocess.run(
+        [sys.executable, "-m", "clonos_tpu.cli", "timeline",
+         str(a), "--report", "json"],
+        capture_output=True, text=True, cwd=REPO,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert out.returncode == 0, out.stderr
+    line = json.loads(out.stdout.strip().splitlines()[-1])
+    assert line["ok"] is True
+    assert line["records"] == 2
+    assert line["inversions"] == []
+
+
+def test_cli_timeline_inversion_fires_armed_recorder(tmp_path, capsys):
+    from clonos_tpu import cli
+    path = tmp_path / "timeline-bad.jsonl"
+    recs = [
+        {"kind": "msg.send", "ts": 0.0, "hlc": [20, 0, "a"],
+         "service": "a", "pid": 1},
+        {"kind": "msg.recv", "ts": 0.0, "hlc": [15, 0, "b"],
+         "service": "b", "pid": 2, "sent": [20, 0, "a"]},
+    ]
+    path.write_text("".join(json.dumps(r) + "\n"
+                            for r in sorted(recs,
+                                            key=lambda r: r["hlc"])))
+    mgr = inc.configure_incidents(str(tmp_path), service="cli")
+    rc_code = cli.main(["timeline", str(path), "--report", "json"])
+    capsys.readouterr()
+    assert rc_code == 1
+    (bundle_path,) = mgr.bundles()
+    b = inc.load_bundle(bundle_path)
+    assert b["trigger"]["kind"] == "timeline.inversion"
+    assert b["trigger"]["count"] == 2      # stamp + merge rule findings
+
+
+# --- the flight recorder -----------------------------------------------------
+
+
+def test_null_incident_manager_is_inert():
+    mgr = inc.get_incidents()
+    assert mgr.enabled is False
+    assert mgr.signal("slo.breach", window=0) is None
+    assert mgr.bundles() == []
+    assert (mgr.captured, mgr.deduped, mgr.suppressed,
+            mgr.signals) == (0, 0, 0, 0)
+    # zero wire/metric surface: registering the Null plane adds nothing
+    reg = MetricRegistry()
+    mgr.register_gauges(reg)
+    assert not any(k.startswith("incident.") for k in reg.snapshot())
+
+
+def _manager(tmp_path, **kw):
+    clock = {"t": 100.0}
+    kw.setdefault("service", "test")
+    kw.setdefault("min_interval_s", 5.0)
+    mgr = inc.IncidentManager(str(tmp_path), clock=lambda: clock["t"],
+                              **kw)
+    return mgr, clock
+
+
+def test_unknown_kind_raises(tmp_path):
+    mgr, _ = _manager(tmp_path)
+    with pytest.raises(ValueError):
+        mgr.signal("not-a-kind")
+
+
+def test_capture_lands_atomic_bundle_with_sections(tmp_path):
+    mgr, _ = _manager(tmp_path)
+    mgr.attach(
+        ledgers=lambda: {"expected": [{"epoch": 3, "channels": {}}],
+                         "actual": [{"epoch": 3, "channels": {}}]},
+        chaos=lambda: "at 1s nondet",
+        config=lambda: {"rate": 100.0},
+        metrics=lambda: [{"metrics": {"m": 1}}])
+    path = mgr.signal("audit.divergence", epoch=3, problem="p")
+    assert path is not None and os.path.isfile(path)
+    assert os.path.basename(path) == "incident-0001-audit.divergence.json"
+    assert not any(n.endswith(".tmp") for n in os.listdir(mgr.dir))
+    b = inc.load_bundle(path)
+    assert b["bundle"]["schema"] == "clonos-incident-bundle/v1"
+    assert b["bundle"]["schema_fingerprint"] == \
+        inc.bundle_schema_fingerprint()
+    assert b["trigger"] == {"kind": "audit.divergence", "epoch": 3,
+                            "problem": "p"}
+    assert b["ledgers"]["actual"][0]["epoch"] == 3
+    assert b["chaos"] == "at 1s nondet"
+    assert b["config"] == {"rate": 100.0}
+    assert mgr.captured == 1 and mgr.signals == 1
+    # incident.* gauges ride a registry like every other plane
+    reg = MetricRegistry()
+    mgr.register_gauges(reg)
+    assert reg.snapshot()["incident.captured"] == 1
+
+
+def test_dedup_rate_limit_and_cap(tmp_path):
+    mgr, clock = _manager(tmp_path, max_bundles=3)
+    assert mgr.signal("slo.breach", window=1) is not None
+    # novel trigger inside min_interval_s of the last capture →
+    # rate-limited
+    clock["t"] += 1.0
+    assert mgr.signal("slo.breach", window=2) is None
+    assert mgr.suppressed == 1
+    # identical trigger → dedup, even after the rate window passes
+    clock["t"] += 100.0
+    assert mgr.signal("slo.breach", window=1) is None
+    assert mgr.deduped == 1
+    assert mgr.signal("slo.breach", window=2) is not None
+    clock["t"] += 100.0
+    assert mgr.signal("slo.breach", window=3) is not None
+    # bundle cap: the 4th novel signal is suppressed, not captured
+    clock["t"] += 100.0
+    assert mgr.signal("slo.breach", window=4) is None
+    assert mgr.captured == 3 and len(mgr.bundles()) == 3
+
+
+def test_restart_resumes_seq_and_dedup(tmp_path):
+    mgr, clock = _manager(tmp_path)
+    mgr.signal("slo.breach", window=1)
+    clock["t"] += 100.0
+    mgr.signal("timeline.inversion", rule="stamp")
+    # a fresh manager over the same root: dedups the old triggers,
+    # continues the sequence numbering
+    mgr2, clock2 = _manager(tmp_path)
+    assert mgr2.signal("slo.breach", window=1) is None
+    assert mgr2.deduped == 1
+    clock2["t"] += 100.0
+    path = mgr2.signal("slo.breach", window=9)
+    assert os.path.basename(path).startswith("incident-0003-")
+
+
+def test_provider_error_degrades_section_not_bundle(tmp_path):
+    mgr, _ = _manager(tmp_path)
+    mgr.attach(ledgers=lambda: 1 / 0)
+    path = mgr.signal("recovery.failure", epoch=1, error="x")
+    b = inc.load_bundle(path)
+    assert "provider-error" in b["ledgers"]
+
+
+def test_ledger_section_trimmed_to_epoch_radius(tmp_path):
+    mgr, _ = _manager(tmp_path, epoch_radius=1)
+    entries = [{"epoch": e, "channels": {}} for e in range(10)]
+    mgr.attach(ledgers=lambda: {"expected": entries, "actual": entries})
+    b = inc.load_bundle(mgr.signal("audit.divergence", epoch=5))
+    assert [e["epoch"] for e in b["ledgers"]["actual"]] == [4, 5, 6]
+
+
+def test_attach_rejects_unknown_slot(tmp_path):
+    mgr, _ = _manager(tmp_path)
+    with pytest.raises(ValueError):
+        mgr.attach(ledgrs=lambda: {})
+
+
+def test_signal_records_capture_on_timeline(tmp_path):
+    configure_timeline("test")
+    mgr, _ = _manager(tmp_path)
+    mgr.signal("slo.breach", window=0)
+    kinds = [r["kind"] for r in get_timeline().records()]
+    assert "incident.captured" in kinds
+
+
+# --- deterministic root cause ------------------------------------------------
+
+
+def test_incident_self_check_clean():
+    assert inc.incident_self_check() == []
+
+
+def test_rootcause_localizes_synthetic_ring_bundle():
+    b = inc._synthetic_bundles()["unlogged-ring"]
+    rep = rc.analyze_bundle(b)
+    assert rep["verdict"] == "localized"
+    assert rep["first_divergent_epoch"] == 2
+    assert rep["first_divergent_channel"] == "ring/v1"
+    assert rep["determinant"]["kind"] == "ring-step"
+    assert "unlogged nondeterminism" in rep["determinant"]["note"]
+    assert rep["injected_by"] == "w0"
+    assert rep["causal_chain"][0]["kind"] == "chaos"
+
+
+def test_rootcause_no_divergence_verdict():
+    entries = [{"epoch": 0, "channels": {
+        "log/0": {"count": 1, "fp": "aa"}}}]
+    b = {"bundle": {"fingerprint": "f", "schema_fingerprint": "s"},
+         "trigger": {"kind": "slo.breach"},
+         "ledgers": {"expected": entries, "actual": entries}}
+    assert rc.analyze_bundle(b)["verdict"] == "no-divergence"
+
+
+def test_explain_byte_identical_across_two_processes(tmp_path):
+    bdir = tmp_path / "incidents"
+    bdir.mkdir()
+    bundle = inc._synthetic_bundles()["unlogged-ring"]
+    path = bdir / "incident-0001-audit.divergence.json"
+    path.write_text(inc.canonical_json(bundle) + "\n")
+
+    def run():
+        return subprocess.run(
+            [sys.executable, "-m", "clonos_tpu.cli", "incident",
+             "explain", str(path), "--report", "json"],
+            capture_output=True, cwd=REPO,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    one, two = run(), run()
+    assert one.returncode == 0, one.stderr
+    assert two.returncode == 0
+    assert one.stdout == two.stdout          # byte-identical
+    rep = json.loads(one.stdout)
+    assert rep["verdict"] == "localized"
+    assert rep["first_divergent_channel"] == "ring/v1"
+
+
+def test_cli_incident_list_show_and_self_check(tmp_path):
+    mgr = inc.configure_incidents(str(tmp_path), service="cli",
+                                  min_interval_s=0.0)
+    mgr.signal("slo.breach", window=0, breaches=["p99"])
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, "-m", "clonos_tpu.cli", "incident", "list",
+         "--dir", str(tmp_path)],
+        capture_output=True, text=True, cwd=REPO, env=env)
+    assert out.returncode == 0
+    assert "slo.breach" in out.stdout
+    out = subprocess.run(
+        [sys.executable, "-m", "clonos_tpu.cli", "incident", "show",
+         "1", "--dir", str(tmp_path)],
+        capture_output=True, text=True, cwd=REPO, env=env)
+    assert out.returncode == 0
+    assert json.loads(out.stdout)["trigger"]["kind"] == "slo.breach"
+    out = subprocess.run(
+        [sys.executable, "-m", "clonos_tpu.cli", "incident",
+         "--self-check"],
+        capture_output=True, text=True, cwd=REPO, env=env)
+    assert out.returncode == 0
+    line = json.loads(out.stdout)
+    assert line["ok"] is True
+    assert line["schema"] == inc.bundle_schema_fingerprint()
+
+
+# --- end-to-end: soak + injected nondet --------------------------------------
+
+
+@pytest.mark.slow
+def test_soak_nondet_auto_captures_and_localizes(tmp_path):
+    """The acceptance path: an unlogged value perturbation (the
+    examples/audit_nondet.py class — ring VALUES salted, counts/keys/
+    timestamps untouched) injected under a live soak. The audit diff
+    fires the flight recorder unprompted; the landed bundle's
+    localization must name a salted ring/* channel, descend to the
+    first divergent determinant ring step, and attribute the injecting
+    worker from the chaos record on the HLC timeline."""
+    from clonos_tpu.soak import (ChaosEvent, ChaosSchedule, SLOSpec,
+                                 SoakConfig, SoakDriver,
+                                 build_soak_fixture)
+
+    mgr = inc.configure_incidents(str(tmp_path / "forensics"),
+                                  service="soak", min_interval_s=0.0)
+    configure_timeline("soak")
+    runner, control, election = build_soak_fixture(
+        str(tmp_path), rate=1200.0, duration_s=4.0,
+        steps_per_epoch=32, seed=11)
+    driver = SoakDriver(
+        runner, SoakConfig(rate=1200.0, duration_s=4.0, window_s=2.0),
+        schedule=ChaosSchedule([ChaosEvent(1.5, "nondet",
+                                           targets=(1,))]),
+        spec=SLOSpec(exactly_once=True),
+        control=control, election=election, records_per_step=16)
+    v = driver.run()
+
+    assert v["pass"] is False                 # the audit caught it
+    assert mgr.captured >= 1                  # ...and the recorder fired
+    paths = mgr.bundles()
+    assert paths
+    bundle = inc.load_bundle(paths[0])
+    assert bundle["trigger"]["kind"] == "audit.divergence"
+    assert bundle["bundle"]["service"] == "soak"
+    assert bundle["chaos"].strip().startswith("at 1.5s nondet")
+
+    rep = rc.analyze_bundle(bundle)
+    assert rep["verdict"].startswith("localized")
+    chan = rep["first_divergent_channel"]
+    assert chan is not None and chan.split("/")[0] in ("ring", "ringsum")
+    # the walk-back found the injection and named the worker
+    assert any(e["kind"] == "chaos" for e in rep["causal_chain"])
+    assert rep["injected_by"] == "1"
+    # determinant descent: when the divergent epoch's window was still
+    # resident at capture time the report names the exact ring step —
+    # and because the salt is value-only, flags it as unlogged nondet
+    det = rep["determinant"]
+    if det is not None:
+        assert det["kind"] == "ring-step"
+        assert det["field"] in ("values", "count", "keys",
+                                "timestamps", "missing-step")
+        assert "unlogged nondeterminism" in det.get("note", "")
+    # the report is byte-stable: a fresh analysis of the re-read
+    # bundle renders identical bytes
+    again = rc.analyze_bundle(inc.load_bundle(paths[0]))
+    assert rc.render_report(rep) == rc.render_report(again)
