@@ -317,9 +317,71 @@ def overhead_probe():
         }
         del runner
         gc.collect()
-        return out
     finally:
         prof_mod.reset_profile()
+    # Lineage on/off cost rides along (unprofiled — the dye plane's
+    # cost is fence-side wall, not a profiler section).
+    try:
+        out["lineage"] = lineage_overhead_probe()
+    except Exception as e:                            # pragma: no cover
+        out["lineage"] = {"error": str(e)}
+    return out
+
+
+def lineage_overhead_probe():
+    """Record-lineage cost at a bench shape (obs/lineage.py): the same
+    short run twice — dye plane disabled (NullLineage: the identity,
+    zero wire fields, zero per-record work, the fence never even
+    extracts the epoch window for it) vs enabled (k records dyed per
+    epoch, hops/determinants/sinks appended to a JSONL observation
+    log at every fence). The disabled wall IS the baseline; the
+    enabled-over-disabled fraction is the full price of answering
+    \"explain this output record\" after the fact."""
+    import gc
+    import tempfile
+    from clonos_tpu.obs.lineage import LineagePlane, NullLineage
+    from clonos_tpu.runtime.cluster import ClusterRunner
+    from clonos_tpu.runtime.executor import DETS_PER_STEP
+
+    SPE = int(os.environ.get("BENCH_LINEAGE_SPE", 256))
+    EPOCHS = 3
+
+    def timed(lin):
+        job = build_job()
+        need = 2 * SPE * DETS_PER_STEP
+        runner = ClusterRunner(
+            job, steps_per_epoch=SPE,
+            log_capacity=1 << need.bit_length(), max_epochs=16,
+            inflight_ring_steps=1 << (SPE - 1).bit_length(), seed=7,
+            lineage=lin)
+        runner.run_epoch(complete_checkpoint=True)   # compile warmup
+        device_sync(runner.executor.carry)
+        t0 = time.monotonic()
+        for _ in range(EPOCHS):
+            runner.run_epoch(complete_checkpoint=True)
+        device_sync(runner.executor.carry)
+        wall = time.monotonic() - t0
+        del runner
+        gc.collect()
+        return wall
+
+    off_s = timed(NullLineage())
+    with tempfile.TemporaryDirectory() as td:
+        lin = LineagePlane(td, service="bench", k=4)
+        on_s = timed(lin)
+        lin.close()
+        dyed, n_obs = lin.dyed, lin.observations
+    return {
+        "lineage_off_s": round(off_s, 3),
+        "lineage_on_s": round(on_s, 3),
+        "lineage_overhead_fraction": (
+            round(max(0.0, on_s / off_s - 1.0), 4) if off_s > 0
+            else None),
+        "records_dyed": dyed,
+        "observations": n_obs,
+        "steps_per_epoch": SPE,
+        "epochs": EPOCHS,
+    }
 
 
 def ablation_probe():
@@ -1111,7 +1173,16 @@ def spill_probe():
 
 
 def main(jobs=None, multichip=None, soak=None, ablate=False,
-         spill=False, serve=None, rescale=None):
+         spill=False, serve=None, rescale=None, overhead=False):
+    global T_START
+    if overhead:
+        # --overhead: run ONLY the FT-overhead attribution probe (the
+        # profiled section breakdown + the lineage on/off cost) — the
+        # standalone escape hatch so a budget-starved headline run
+        # never leaves the overhead numbers unmeasured.
+        T_START = time.monotonic()
+        print(json.dumps(overhead_probe()))
+        return
     if rescale:
         # --rescale [SECONDS]: run ONLY the elastic-repartition probe
         # (one JSON line, same contract as the headline bench) and
@@ -1167,7 +1238,6 @@ def main(jobs=None, multichip=None, soak=None, ablate=False,
     from clonos_tpu.runtime.executor import DETS_PER_STEP
     from clonos_tpu.causal import recovery as rec
 
-    global T_START
     T_START = time.monotonic()
     job = build_job()
     # Log capacity sized to hold FILL_EPOCHS * STEPS_PER_EPOCH * 4 sync
@@ -1297,8 +1367,16 @@ def main(jobs=None, multichip=None, soak=None, ablate=False,
     # bounds that bias in the artifact itself instead of pretending the
     # first A and B were exchangeable.
     budget_s = float(os.environ.get("BENCH_MAX_S", 1500))
+    # The tail of the budget is RESERVED for the overhead probe:
+    # BENCH_r06 let the secondary configs eat the whole budget and the
+    # probe starved ({"skipped": ...}). Everything optional before the
+    # probe now stops at soft_budget_s so the probe always gets its
+    # slice; `bench.py --overhead` runs it standalone besides.
+    overhead_reserve_s = float(
+        os.environ.get("BENCH_OVERHEAD_RESERVE_S", 180))
+    soft_budget_s = max(0.0, budget_s - overhead_reserve_s)
     throughput_rerun = None
-    if time.monotonic() - T_START <= budget_s:
+    if time.monotonic() - T_START <= soft_budget_s:
         runner.coordinator.drain()
         last_fence = runner.executor.epoch_id - 1
         runner.coordinator.discard_pending_through(last_fence - 1)
@@ -1523,7 +1601,7 @@ def main(jobs=None, multichip=None, soak=None, ablate=False,
                      bench_config4),
                     ("config5_join_128task_external_services",
                      bench_config5)):
-        if time.monotonic() - T_START > budget_s:
+        if time.monotonic() - T_START > soft_budget_s:
             out[key] = {"skipped": "bench wall-clock budget exhausted"}
             continue
         try:
@@ -1537,7 +1615,9 @@ def main(jobs=None, multichip=None, soak=None, ablate=False,
         out["sharing_depth_sweep"] = {"error": str(e)}
     # FT-overhead attribution probe (profiled, serialized dispatch —
     # never shares the pipelined headline run). Hoists the headline
-    # fraction to the top level for dashboards.
+    # fraction to the top level for dashboards. Runs inside its own
+    # reserved slice (see soft_budget_s above) — only a headline run
+    # that itself blew through the FULL budget skips it.
     if time.monotonic() - T_START > budget_s:
         out["overhead_probe"] = {"skipped": "bench wall-clock budget "
                                             "exhausted"}
@@ -1577,6 +1657,10 @@ if __name__ == "__main__":
                     help="run the open-loop soak probe (fixed-rate "
                          "load + seeded chaos + exactly-once audit) "
                          "instead of the headline bench")
+    ap.add_argument("--overhead", action="store_true",
+                    help="run ONLY the FT-overhead attribution probe "
+                         "(profiled section breakdown + lineage "
+                         "on/off cost) instead of the headline bench")
     ap.add_argument("--ablate", action="store_true",
                     help="run the no-FT ablation probe (twin executor "
                          "head-to-head, measured vs static ft-fraction) "
@@ -1604,4 +1688,4 @@ if __name__ == "__main__":
     _a = ap.parse_args()
     sys.exit(main(jobs=_a.jobs, multichip=_a.multichip, soak=_a.soak,
                   ablate=_a.ablate, spill=_a.spill, serve=_a.serve,
-                  rescale=_a.rescale))
+                  rescale=_a.rescale, overhead=_a.overhead))

@@ -146,3 +146,47 @@ def decode_delta(frame: bytes, subtasks_per_vertex: int = 1
     else:
         raise ValueError(f"unknown delta encoding {enc}")
     return deltas
+
+
+# --- lineage tag piggyback ---------------------------------------------------
+# obs/lineage.py dyes k records per epoch by key hash; when exchanges
+# leave the process, the dyed records' compact tags ride ordinary data
+# frames next to the determinant deltas above (the ROADMAP multi-host
+# item's "piggybacked on ordinary data messages", paid only for the k
+# dyed records — a disabled plane ships zero tag bytes). One tag is
+# five i64 lanes:
+#
+#     tag   = src_offset i64 | epoch i64 | step i64 | worker i64 |
+#             vertex i64
+#     frame = MAGIC u32 | encoding(=2) u8 | count u32 | tags | crc32 u32
+
+LINEAGE = 2
+
+#: one dyed record's tag: (src_offset, epoch, step, worker, vertex)
+LineageTag = Tuple[int, int, int, int, int]
+
+_TAG_LANES = 5
+
+
+def encode_lineage_tags(tags: Sequence[LineageTag]) -> bytes:
+    """Frame dyed-record lineage tags for the cross-host data path."""
+    arr = np.asarray(list(tags), np.int64).reshape(-1, _TAG_LANES)
+    payload = np.ascontiguousarray(arr).tobytes()
+    return (_HDR.pack(MAGIC, LINEAGE, arr.shape[0]) + payload
+            + _CRC.pack(zlib.crc32(payload)))
+
+
+def decode_lineage_tags(frame: bytes) -> List[LineageTag]:
+    """Decode a lineage-tag frame (CRC-checked, like delta rows)."""
+    magic, enc, count = _HDR.unpack_from(frame, 0)
+    if magic != MAGIC:
+        raise ValueError(f"bad lineage frame magic {magic:#x}")
+    if enc != LINEAGE:
+        raise ValueError(f"not a lineage frame (encoding {enc})")
+    nbytes = count * _TAG_LANES * 8
+    arr = np.frombuffer(frame, np.int64, count * _TAG_LANES,
+                        _HDR.size).reshape(count, _TAG_LANES)
+    (crc,) = _CRC.unpack_from(frame, _HDR.size + nbytes)
+    if crc != zlib.crc32(frame[_HDR.size:_HDR.size + nbytes]):
+        raise ValueError("lineage tag CRC mismatch (corrupt frame)")
+    return [tuple(int(x) for x in row) for row in arr]

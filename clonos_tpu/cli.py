@@ -708,6 +708,20 @@ def _top_table(snap) -> str:
         lines.append("")
         lines.append("incidents: " + "  ".join(
             f"{k}={v}" for k, v in sorted(incidents.items())))
+    # Lineage status row: the dye plane's lineage.* gauges (records
+    # dyed, observations logged, epochs scanned) — same convention.
+    lineage = {}
+    for k, v in sorted(snap.items()):
+        if not isinstance(v, (int, float)) or isinstance(v, bool):
+            continue
+        if k.startswith("lineage."):
+            lineage[k[len("lineage."):]] = v
+        elif ".lineage." in k:
+            lineage.setdefault(k.rsplit(".lineage.", 1)[1], v)
+    if lineage:
+        lines.append("")
+        lines.append("lineage: " + "  ".join(
+            f"{k}={v}" for k, v in sorted(lineage.items())))
     tenant = {k: v for k, v in sorted(snap.items())
               if (k.startswith("tenant.")
                   or k.startswith("dispatcher."))
@@ -1279,6 +1293,72 @@ def cmd_incident(args) -> int:
     return 0 if ok else 1
 
 
+def cmd_lineage(args) -> int:
+    """Record-level lineage (``clonos_tpu lineage``): reconstruct dyed
+    records' causal paths from any number of per-process
+    ``lineage-*.jsonl`` observation files (source offset → every
+    vertex/step → sink part or serve read, with the ORDER/TIMESTAMP/RNG
+    determinant rows that influenced them). The reconstructor is pure
+    and order-free, so any process renders the same bytes
+    (obs/lineage.render_trace — the rootcause convention).
+    ``--report json`` is the CI gate: the canonical one-line report,
+    exit 0 (every path reaches a terminus) / 1 (broken paths);
+    ``--key`` traces one record; ``--chrome`` exports the paths through
+    the same validated trace_event writer as ``clonos_tpu trace``;
+    ``--self-check`` is the conftest gate (synthetic observations
+    through the full join, byte-identity enforced)."""
+    from clonos_tpu import obs
+    from clonos_tpu.obs import lineage as lin
+
+    if args.self_check:
+        findings = lin.lineage_self_check()
+        print(json.dumps({"ok": not findings, "check": "record-lineage",
+                          "schema": lin.lineage_schema_fingerprint(),
+                          "findings": findings}))
+        return 0 if not findings else 1
+
+    if not args.files:
+        print("lineage: at least one lineage-*.jsonl file required "
+              "(or --self-check)", file=sys.stderr)
+        return 2
+    try:
+        observations = lin.read_observations(args.files)
+    except (OSError, ValueError) as e:
+        print(f"lineage: {e}", file=sys.stderr)
+        return 1
+
+    if args.key is not None:
+        report = lin.trace_key(observations, args.key)
+        if args.report == "json":
+            sys.stdout.write(lin.render_trace(report))
+            return 0 if report["ok"] else 1
+        path = report["path"]
+        if path is None:
+            print(f"lineage: key {args.key} was never dyed/observed",
+                  file=sys.stderr)
+            return 1
+        full = lin.reconstruct(observations)
+        full["keys"] = {str(args.key): path}
+        print(lin.format_trace(dict(full, ok=report["ok"],
+                                    broken_keys=path["broken"])),
+              end="")
+        return 0 if report["ok"] else 1
+
+    report = lin.reconstruct(observations)
+    if args.chrome:
+        doc = obs.to_chrome(lin.to_trace_records(report))
+        n = obs.validate_chrome(doc)
+        with open(args.chrome, "w") as f:
+            json.dump(doc, f)
+        print(json.dumps({"events": n, "out": args.chrome}))
+        return 0
+    if args.report == "json":
+        sys.stdout.write(lin.render_trace(report))
+        return 0 if report["ok"] else 1
+    print(lin.format_trace(report), end="")
+    return 0 if report["ok"] else 1
+
+
 def cmd_soak(args) -> int:
     """Open-loop soak run (``clonos_tpu soak``): paced load at a fixed
     ingestion rate, a seeded (or explicit) chaos schedule, windowed SLO
@@ -1308,6 +1388,14 @@ def cmd_soak(args) -> int:
         # `clonos_tpu incident explain` localizes it afterwards.
         from clonos_tpu.obs import configure_incidents
         configure_incidents(workdir, service="soak")
+    if args.lineage:
+        # Record-level dye (obs/lineage.py): arm the process plane so
+        # build_soak_fixture gives BOTH twins per-twin planes with the
+        # same dye config — k records per epoch dyed by key hash, every
+        # hop/sink observed at the seals; `clonos_tpu lineage
+        # <workdir>/lineage-*.jsonl` reconstructs the paths afterwards.
+        from clonos_tpu.obs import configure_lineage
+        configure_lineage(workdir, service="soak")
     runner, control, election = build_soak_fixture(
         workdir, rate=args.rate, duration_s=args.duration,
         steps_per_epoch=args.steps_per_epoch, par=args.parallelism,
@@ -1406,6 +1494,9 @@ def cmd_soak(args) -> int:
         if args.incidents:
             from clonos_tpu.obs.incident import get_incidents
             line["incidents"] = get_incidents().captured
+        if args.lineage:
+            line["lineage_dyed"] = runner.lineage.dyed
+            line["lineage_observations"] = runner.lineage.observations
         print(json.dumps(line))
         return rc
     lat = verdict["latency"]
@@ -1450,6 +1541,12 @@ def cmd_soak(args) -> int:
             print(f"incidents: {mgr.captured} bundle(s) under "
                   f"{mgr.dir} — `clonos_tpu incident explain "
                   f"--dir {workdir}`")
+    if args.lineage:
+        lin = runner.lineage
+        print(f"lineage: {lin.dyed} records dyed, "
+              f"{lin.observations} observations across "
+              f"{lin.epochs_observed} epochs — `clonos_tpu lineage "
+              f"{workdir}/lineage-*.jsonl`")
     print(f"artifact: {out_path}")
     return rc
 
@@ -1759,6 +1856,30 @@ def main(argv=None) -> int:
                          "on synthetic bundles (no files); exit 0/1")
     pn.set_defaults(fn=cmd_incident)
 
+    pg = sub.add_parser("lineage",
+                        help="reconstruct dyed records' end-to-end "
+                             "causal paths from lineage-*.jsonl "
+                             "observation files")
+    pg.add_argument("files", nargs="*",
+                    help="per-process lineage-*.jsonl files (any "
+                         "subset joins; torn tails from a SIGKILLed "
+                         "writer are tolerated)")
+    pg.add_argument("--key", type=int, default=None, metavar="K",
+                    help="trace one record key end to end; exit 1 if "
+                         "its path is broken or it was never dyed")
+    pg.add_argument("--report", choices=["json"], default=None,
+                    help="one canonical JSON line (byte-identical "
+                         "across processes); exit 0 when every dyed "
+                         "path reaches a terminus / 1 on broken paths")
+    pg.add_argument("--chrome", default=None, metavar="OUT",
+                    help="export the paths as a validated Chrome "
+                         "trace_event file (chrome://tracing, "
+                         "Perfetto)")
+    pg.add_argument("--self-check", action="store_true",
+                    help="run the deterministic lineage self-check on "
+                         "synthetic observations (no files); exit 0/1")
+    pg.set_defaults(fn=cmd_lineage)
+
     pa = sub.add_parser("audit", help="print or diff a job's epoch "
                                       "audit ledger")
     pa.add_argument("dir", help="checkpoint dir (or slot-pool "
@@ -1867,6 +1988,16 @@ def main(argv=None) -> int:
                          "forensic bundles under <workdir>/incidents/ "
                          "for `clonos_tpu incident explain` (off by "
                          "default: zero overhead, zero wire fields)")
+    pk.add_argument("--lineage", action="store_true",
+                    help="arm the record-level lineage plane: a "
+                         "deterministic sampler dyes k records per "
+                         "epoch by key hash (the control twin dyes "
+                         "the SAME records, zero coordination) and "
+                         "every fence logs their hops, determinant "
+                         "rows, and sink/serve termini to "
+                         "<workdir>/lineage-*.jsonl for `clonos_tpu "
+                         "lineage` (off by default: zero overhead, "
+                         "zero wire fields)")
     pk.add_argument("--detect-gray", action="store_true",
                     help="score the gray-failure detector at every "
                          "completed fence (cluster.health.* gauges, "

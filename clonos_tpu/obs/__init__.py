@@ -43,6 +43,12 @@ from .incident import (IncidentManager, NullIncidentManager,  # noqa: F401
                        reset_incidents, summarize_window)
 from .rootcause import (RootCauseAnalyzer, analyze_bundle,  # noqa: F401
                         format_report, render_report)
+from .lineage import (LineagePlane, NullLineage,  # noqa: F401
+                      configure_lineage, dye_hash, format_trace,
+                      get_lineage, lineage_schema_fingerprint,
+                      lineage_self_check, read_observations,
+                      reconstruct, render_trace, reset_lineage,
+                      select_dyed, trace_key)
 
 __all__ = ["Tracer", "NullTracer", "get_tracer", "configure", "reset",
            "load_jsonl", "to_chrome", "validate_chrome", "summarize",
@@ -70,4 +76,9 @@ __all__ = ["Tracer", "NullTracer", "get_tracer", "configure", "reset",
            "capture_epoch_window", "summarize_window",
            "incident_self_check",
            "RootCauseAnalyzer", "analyze_bundle", "render_report",
-           "format_report"]
+           "format_report",
+           "LineagePlane", "NullLineage", "get_lineage",
+           "configure_lineage", "reset_lineage", "select_dyed",
+           "dye_hash", "read_observations", "reconstruct",
+           "trace_key", "render_trace", "format_trace",
+           "lineage_schema_fingerprint", "lineage_self_check"]

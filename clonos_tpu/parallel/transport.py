@@ -214,6 +214,38 @@ def adopt_hlc(header: Dict[str, Any],
                       sent=list(sent))
 
 
+# --- lineage-context convention ----------------------------------------------
+# Same shape once more, for the record-lineage plane (obs/lineage.py):
+# a JobMaster with lineage configured stamps its dye config (root, k,
+# salt) on DEPLOY headers so every worker runner dyes the SAME records
+# — the dye is a pure key-hash function, so shipping three ints IS the
+# whole coordination; the per-record tag codec
+# (causal/serde.encode_lineage_tags) rides ordinary data messages when
+# exchanges leave the process. A disabled plane attaches NOTHING:
+# lineage-off wire bytes are identical to a pre-lineage build.
+
+def attach_lineage(header: Dict[str, Any]) -> Dict[str, Any]:
+    """Stamp the process lineage plane's dye config on a JSON header
+    (in place)."""
+    from clonos_tpu.obs.lineage import get_lineage
+    ctx = get_lineage().wire_config()
+    if ctx is not None:
+        header["lineage"] = ctx
+    return header
+
+
+def adopt_lineage(header: Dict[str, Any]) -> None:
+    """Enable process-wide lineage per a received header's ``lineage``
+    field (no-op without one; runners built AFTER adoption inherit —
+    same dye root/k/salt as the sender, so both sides dye the same
+    records)."""
+    from clonos_tpu.obs.lineage import configure_lineage, get_lineage
+    ctx = header.get("lineage")
+    if ctx and not get_lineage().enabled:
+        configure_lineage(str(ctx["root"]), k=int(ctx.get("k", 4)),
+                          salt=int(ctx.get("salt", 0)))
+
+
 class ControlServer:
     """Threaded request/response endpoint. ``handler(mtype, payload) ->
     (mtype, payload)`` runs per request; one TCP connection may carry many

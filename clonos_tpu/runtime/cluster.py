@@ -261,6 +261,7 @@ class ClusterRunner:
                  latency_marker_every: Optional[int] = None,
                  audit: Optional[bool] = None,
                  audit_on_divergence: Optional[str] = None,
+                 lineage=None,
                  compile_cache_dir: Optional[str] = None,
                  overlap_recovery: bool = True,
                  overlap_epoch: bool = False,
@@ -434,6 +435,22 @@ class ClusterRunner:
         _inc = _inc_mod.get_incidents()
         if _inc.enabled:
             _inc.register_gauges(self.metrics)
+        # Record-level lineage plane (obs/lineage.py): per-runner
+        # binding like the auditor — ``lineage=None`` inherits the
+        # process-global plane (set by CLI/soak arming or adopted from
+        # a DEPLOY header via transport.adopt_lineage); callers that
+        # run twins in one process (the soak control) pass distinct
+        # planes so each runner's observations land in its own file.
+        # The NullLineage default scans nothing and registers nothing.
+        from clonos_tpu.obs import lineage as _lin_mod
+        self.lineage = (lineage if lineage is not None
+                        else _lin_mod.get_lineage())
+        if self.lineage.enabled:
+            self.lineage.register_gauges(self.metrics)
+        #: vertex id -> parallelism, for the lineage plane's
+        #: key-group/subtask attribution at the seal scan.
+        self._lineage_topology = {v.vertex_id: v.parallelism
+                                  for v in job.vertices}
         # Live exactly-once health: how hard the in-flight rings are
         # holding un-truncated history (backpressure proxy — rings only
         # grow when checkpoints lag), and how many supersteps a failure
@@ -1751,7 +1768,8 @@ class ClusterRunner:
         # causal surface, so a serving-only run (audit off) still pays
         # exactly one extraction and a dual run pays no second one.
         win = (window_fn()
-               if self.auditor.enabled or self.serve_feeds else None)
+               if self.auditor.enabled or self.serve_feeds
+               or self.lineage.enabled else None)
         if self.auditor.enabled:
             from clonos_tpu.obs import audit as _audit_mod
             t = _time.monotonic()
@@ -1786,6 +1804,21 @@ class ClusterRunner:
             for fn in list(self.serve_feeds):
                 fn(closed, win)
             phases["fence.serve-feed"] = (_time.monotonic() - t) * 1e3
+        # Lineage capture at the seal (obs/lineage.py): scan the same
+        # extracted window for dyed keys — plus the epoch's sink
+        # transaction shards for termini (complete at the fence in
+        # both modes; the pipelined path seals them on the main thread
+        # before this worker starts). Null plane: no scan, no file.
+        if self.lineage.enabled and win is not None:
+            t = _time.monotonic()
+            self.lineage.observe_epoch(
+                closed, win,
+                num_key_groups=self.job.num_key_groups,
+                topology=self._lineage_topology,
+                parts={vid: tl.pending_shards(closed)
+                       for vid, tl in self.txn_logs.items()})
+            phases["fence.lineage-observe"] = (
+                _time.monotonic() - t) * 1e3
         # Checkpoint at the fence: the lean fence snapshot (op state
         # + offsets; logs/rings are truncated on completion, not
         # persisted).
@@ -1906,7 +1939,8 @@ class ClusterRunner:
         phases: Dict[str, float] = {}
         # clonos: overlap-window-begin
         handles = self.executor.capture_fence(
-            with_window=self.auditor.enabled or bool(self.serve_feeds))
+            with_window=self.auditor.enabled or bool(self.serve_feeds)
+            or self.lineage.enabled)
         snap = self.executor.lean_snapshot()
         self._append_source_fence_determinant(closed, phases, prof)
         # clonos: overlap-window-end

@@ -593,6 +593,19 @@ def _slice_ring_window(epoch: int, keys: np.ndarray, values: np.ndarray,
     return steps
 
 
+def iter_ring_steps(window: Dict[str, Any]):
+    """Deterministic scan order over one ``epoch_window`` dict's ring
+    section: ``(vertex_id, step_seq, keys, values, timestamps)`` tuples,
+    vertices ascending, steps in epoch-relative order, records already
+    in the (lane, slot) order :func:`_slice_ring_window` fixed. The
+    lineage plane's dye scan (obs/lineage.py) consumes this so the
+    window shape stays owned here."""
+    rings = window.get("rings", {}) or {}
+    for vid in sorted(rings, key=int):
+        for seq, (keys, values, stamps) in enumerate(rings[vid]):
+            yield int(vid), seq, keys, values, stamps
+
+
 class FenceHandles:
     """Device-side capture of one closed epoch's fence surface — the
     health vector plus (optionally) the causal-log / in-flight-ring

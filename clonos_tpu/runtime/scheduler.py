@@ -780,6 +780,7 @@ class SliceWorker:
         tp.adopt_trace(tdd)
         tp.adopt_audit(tdd)
         tp.adopt_profile(tdd)
+        tp.adopt_lineage(tdd)
         tp.adopt_hlc(tdd, verb="DEPLOY")
         tr = get_tracer()
         self._task_state(group, "DEPLOYING", job_id=jid, attempt=attempt)
@@ -1108,8 +1109,9 @@ class SlotPoolScheduler:
         ctx = self._tr().wire_context()
         if ctx is not None:
             hdr["trace"] = ctx
-        tdd = tp.attach_hlc(tp.attach_profile(tp.attach_audit(hdr)),
-                            verb="DEPLOY")
+        tdd = tp.attach_hlc(
+            tp.attach_lineage(tp.attach_profile(tp.attach_audit(hdr))),
+            verb="DEPLOY")
         span_kw = {"job": self.job_id} if self.job_id else {}
         t0 = time.monotonic()
         with self._tr().span("deploy", group=group, worker=worker_id,

@@ -387,16 +387,19 @@ class ReplicaServeEndpoint:
         if ticket["err"] is not None:
             return tp.ERROR, tp.pack_json(ticket["err"])
         vals, subs, kgs, epoch, stal = ticket["out"]
+        # ``replica`` + ``epoch`` are the provenance stamp a lineage
+        # path terminates on (the router adds ``rerouted``).
         if single:
             return tp.QUERY_RESPONSE, tp.pack_json(
                 {"value": vals[0], "subtask": subs[0],
                  "key_group": kgs[0], "epoch": epoch,
                  "staleness_epochs": stal, "served_by":
-                 self.replica.name})
+                 self.replica.name, "replica": self.replica.name})
         return tp.QUERY_BATCH_RESPONSE, tp.pack_json(
             {"values": vals, "subtasks": subs, "key_groups": kgs,
              "epoch": epoch, "staleness_epochs": stal,
-             "served_by": self.replica.name})
+             "served_by": self.replica.name,
+             "replica": self.replica.name})
 
     # --- the single dispatch thread --------------------------------------
 
@@ -543,8 +546,13 @@ class ServeRouter:
 
     def __init__(self, owner, replicas: Sequence,
                  num_key_groups: int, staleness_bound: int = 2,
-                 status_ttl_s: float = 0.05):
+                 status_ttl_s: float = 0.05, lineage=None):
         self.owner = owner
+        #: lineage plane for serve-read termini (obs/lineage.py);
+        #: None resolves to the process-global plane per read, so a
+        #: router built before arming still records. Dyed keys only —
+        #: the Null plane records nothing.
+        self.lineage = lineage
         self.replicas = list(replicas)
         self.num_key_groups = int(num_key_groups)
         self.staleness_bound = int(staleness_bound)
@@ -611,11 +619,18 @@ class ServeRouter:
 
     # --- reads -----------------------------------------------------------
 
+    def _lineage(self):
+        if self.lineage is not None:
+            return self.lineage
+        from clonos_tpu.obs.lineage import get_lineage
+        return get_lineage()
+
     def query(self, vertex: int, key: int, state: str = "acc") -> dict:
         t0 = _time.monotonic()
         kg = self.key_group(key)
         i = self.replica_for_group(kg)
         out = None
+        rerouted = False
         if self._usable(i):
             try:
                 out = self.replicas[i].query(vertex, key, state=state)
@@ -629,8 +644,20 @@ class ServeRouter:
         if out is None:
             if i is not None:
                 self.reroutes += 1
+                rerouted = True
             out = self.owner.query(vertex, key, state=state)
             self.owner_reads += 1
+        # Provenance stamp: which endpoint actually answered, at which
+        # sealed epoch, and whether the read fell back to the owner —
+        # enough for a lineage path to terminate at this read.
+        out = dict(out)
+        out["replica"] = str(out.get("served_by", "owner"))
+        out["rerouted"] = rerouted
+        lin = self._lineage()
+        if lin.enabled:
+            lin.observe_serve(key, epoch=int(out.get("epoch", -1)),
+                              replica=out["replica"],
+                              rerouted=rerouted)
         self.reads += 1
         self.recent_ms.append((_time.monotonic() - t0) * 1e3)
         return out
@@ -644,17 +671,20 @@ class ServeRouter:
         t0 = _time.monotonic()
         keys = [int(k) for k in keys]
         groups: Dict[object, List[int]] = {}
+        routed_away: List[int] = []
         for pos, k in enumerate(keys):
             i = self.replica_for_group(self.key_group(k))
             dest = i if self._usable(i) else None
             if dest is None and i is not None:
                 self.reroutes += 1
+                routed_away.append(pos)
             groups.setdefault(dest, []).append(pos)
         n = len(keys)
         values = [None] * n
         epochs = [None] * n
         stals = [None] * n
         served = [None] * n
+        rerouted = [False] * n
         for dest, positions in groups.items():
             sub_keys = [keys[p] for p in positions]
             out = None
@@ -667,6 +697,8 @@ class ServeRouter:
                         IndexError):
                     self._invalidate(dest)
                     self.reroutes += len(positions)
+                    for p in positions:
+                        rerouted[p] = True
                     out = None
             if out is None:
                 out = self.owner.query_batch(vertex, sub_keys,
@@ -678,10 +710,19 @@ class ServeRouter:
                 epochs[p] = out["epoch"]
                 stals[p] = out.get("staleness_epochs", 0)
                 served[p] = who
+        for p in routed_away:
+            rerouted[p] = True
+        lin = self._lineage()
+        if lin.enabled:
+            for p, k in enumerate(keys):
+                lin.observe_serve(k, epoch=int(epochs[p] or -1),
+                                  replica=str(served[p]),
+                                  rerouted=rerouted[p])
         self.reads += n
         self.recent_ms.append((_time.monotonic() - t0) * 1e3)
         return {"values": values, "epochs": epochs,
-                "staleness_epochs": stals, "served_by": served}
+                "staleness_epochs": stals, "served_by": served,
+                "rerouted": rerouted}
 
 
 class ServeTier:
@@ -713,7 +754,8 @@ class ServeTier:
         self.router = ServeRouter(
             self.owner_client, self.clients,
             num_key_groups=runner.job.num_key_groups,
-            staleness_bound=staleness_bound)
+            staleness_bound=staleness_bound,
+            lineage=getattr(runner, "lineage", None))
         # Owner endpoint snapshots refresh at every fence (fence hooks
         # run before truncation, after the seal stamped
         # last_sealed_epoch on the sequential path).

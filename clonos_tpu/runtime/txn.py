@@ -124,6 +124,14 @@ class TransactionLog:
 
     # --- introspection -------------------------------------------------------
 
+    def pending_shards(self, epoch: int) -> Dict[int, np.ndarray]:
+        """One epoch's accumulated per-subtask ``[n, 3]`` records (the
+        merged view :meth:`seal` pre-commits) — empty when the epoch has
+        no pending transaction. Read-only: the lineage plane scans this
+        at the fence for dyed sink termini."""
+        txn = self._pending.get(epoch)
+        return self._merged_shards(txn) if txn is not None else {}
+
     def committed_stream(self) -> np.ndarray:
         """All committed records in commit order — what the external
         consumer has observed."""

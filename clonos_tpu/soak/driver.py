@@ -1241,6 +1241,20 @@ def build_soak_fixture(workdir: str, rate: float, duration_s: float,
     log_capacity = 1 << (2 * span * DETS_PER_STEP).bit_length()
     ring_steps = 1 << (span - 1).bit_length()
 
+    def lineage_for(sub):
+        # One plane per twin, SAME dye config (k, salt come from the
+        # armed process plane): both runners dye identical records —
+        # the dye is a pure key-hash function and logical time makes
+        # their windows bit-identical — but observations land in
+        # per-twin files, so `clonos_tpu lineage` can diff the faulted
+        # path against the fault-free one byte for byte.
+        from clonos_tpu.obs.lineage import LineagePlane, get_lineage
+        g = get_lineage()
+        if not g.enabled:
+            return None
+        return LineagePlane(g.root, service=f"soak-{sub}", k=g.k,
+                            salt=g.salt)
+
     def runner_for(sub, overlap=False):
         return ClusterRunner(
             build(), steps_per_epoch=steps_per_epoch,
@@ -1248,6 +1262,7 @@ def build_soak_fixture(workdir: str, rate: float, duration_s: float,
             inflight_ring_steps=ring_steps,
             checkpoint_dir=os.path.join(workdir, sub),
             audit=audit, logical_time=True, seed=seed,
+            lineage=lineage_for(sub),
             overlap_epoch=overlap)
 
     def arm_rescaler(r, sub, overlap=False):
@@ -1263,7 +1278,7 @@ def build_soak_fixture(workdir: str, rate: float, duration_s: float,
                 inflight_ring_steps=ring_steps,
                 checkpoint_dir=os.path.join(workdir, sub),
                 audit=audit, logical_time=True, seed=seed,
-                overlap_epoch=overlap)
+                lineage=r.lineage, overlap_epoch=overlap)
             arm_rescaler(nr, sub, overlap)
             return nr, stats
         r._soak_rescaler = rescale

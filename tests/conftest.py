@@ -147,6 +147,40 @@ def pytest_configure(config):
                 f"incident import bundle_schema_fingerprint; "
                 f"print(bundle_schema_fingerprint())\" "
                 f"> .clonos-incident-schema")
+    # Record-lineage gate (clonos_tpu lineage --self-check): synthetic
+    # observations through the full dye → hop → terminus join, with
+    # byte-identity enforced across a JSON round-trip AND a shuffled
+    # observation order (two processes must render the same trace).
+    # Pure and jax-free — a drifting reconstructor fails the session
+    # here, not while someone is tracing a lost record.
+    from clonos_tpu.obs.lineage import (lineage_schema_fingerprint,
+                                        lineage_self_check)
+    lfindings = lineage_self_check()
+    if lfindings:
+        raise pytest.UsageError(
+            "record-lineage self-check failed (clonos_tpu lineage "
+            "--self-check): " + "; ".join(
+                f"[{f['rule']}] {f['detail']}" for f in lfindings))
+    # Lineage-schema drift gate: lineage-*.jsonl observation files are
+    # durable run artifacts — the schema changing silently orphans
+    # every file already on disk. The pinned fingerprint must match.
+    lpin_path = os.path.join(_REPO_ROOT, ".clonos-lineage-schema")
+    if os.path.isfile(lpin_path):
+        with open(lpin_path) as f:
+            toks = f.read().split()
+        pinned = toks[0] if toks else ""
+        fp = lineage_schema_fingerprint()
+        if fp != pinned:
+            raise pytest.UsageError(
+                f"lineage schema drift: fingerprint {fp} != pinned "
+                f"{pinned} (.clonos-lineage-schema) — the observation "
+                f"layout changed; bump LINEAGE_SCHEMA's version "
+                f"(obs/lineage.py) so old observation files stay "
+                f"readable, then re-pin with\n  python -c \"from "
+                f"clonos_tpu.obs.lineage import "
+                f"lineage_schema_fingerprint; "
+                f"print(lineage_schema_fingerprint())\" "
+                f"> .clonos-lineage-schema")
 
 
 @pytest.fixture

@@ -1,0 +1,334 @@
+"""Record-level lineage plane (clonos_tpu/obs/lineage.py).
+
+Unit layers first — the dye sampler (a pure key-hash function, so a
+control twin dyes the SAME records with zero coordination), the
+NullLineage identity (disabled = zero wire fields, zero per-record
+work), and the torn-tail-tolerant observation reader. Then the live
+capture path: an in-process cluster runs epochs under a plane and the
+reconstructed report must join every dyed record's hops and
+determinant context into an unbroken path; byte-identity of ``lineage
+--report json`` is asserted across two fresh interpreter processes
+(the rootcause.py convention). The serve-read terminus rides the
+router's provenance stamp (replica id, epoch, rerouted flag). The slow
+test is the headline acceptance: a soak with ``--lineage`` armed takes
+a mid-run kill, and the dyed records' reconstructed paths must come
+out byte-identical to the fault-free control twin's.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from clonos_tpu.obs import lineage as lin
+from clonos_tpu.utils.metrics import MetricRegistry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _reset_globals():
+    yield
+    lin.reset_lineage()
+
+
+def _cli_lineage(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "clonos_tpu.cli", "lineage", *args],
+        capture_output=True, text=True, cwd=REPO,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+
+
+# --- the dye sampler ---------------------------------------------------------
+
+
+def test_select_dyed_pure_function_of_the_key_set():
+    keys = [5, 3, 9, 3, 5, 12, 44, 7]
+    a = lin.select_dyed(keys, epoch=6, salt=17, k=3)
+    # permutation + duplicates never change the dye set (the control
+    # twin sees the same keys in a different ring order)
+    b = lin.select_dyed(list(reversed(sorted(set(keys)))), epoch=6,
+                        salt=17, k=3)
+    assert a == b
+    assert len(a) == 3 and len(set(a)) == 3
+    assert set(a) <= set(keys)
+    # k >= population dyes everything; k=0 dyes nothing
+    assert set(lin.select_dyed(keys, epoch=6, salt=17, k=99)) \
+        == set(keys)
+    assert lin.select_dyed(keys, epoch=6, salt=17, k=0) == []
+    # epoch and salt both rotate the sample
+    assert lin.select_dyed(keys, epoch=7, salt=17, k=3) != a \
+        or lin.select_dyed(keys, epoch=8, salt=17, k=3) != a
+
+
+def test_dye_hash_is_stable():
+    assert lin.dye_hash(7, 3, 17) == lin.dye_hash(7, 3, 17)
+    assert lin.dye_hash(7, 3, 17) != lin.dye_hash(7, 4, 17)
+
+
+# --- the disabled identity ---------------------------------------------------
+
+
+def test_null_lineage_is_inert_and_default():
+    g = lin.get_lineage()
+    assert isinstance(g, lin.NullLineage)
+    assert g.enabled is False
+    assert g.wire_config() is None
+    assert g.observe_epoch(0, {"logs": {}, "rings": {}}) == 0
+    assert g.observe_serve(5, epoch=0, replica="r") is False
+    assert g.is_dyed(5) is False
+    g.register_gauges(MetricRegistry())
+    g.sync()
+    g.close()
+
+
+def test_wire_stamp_only_when_enabled(tmp_path):
+    from clonos_tpu.parallel import transport as tp
+
+    hdr = tp.attach_lineage({"verb": "DEPLOY"})
+    assert "lineage" not in hdr, "disabled must add ZERO wire fields"
+    lin.configure_lineage(str(tmp_path), k=2, salt=99)
+    hdr = tp.attach_lineage({"verb": "DEPLOY"})
+    assert hdr["lineage"]["k"] == 2 and hdr["lineage"]["salt"] == 99
+    # a fresh (disabled) receiver adopts the sender's dye config
+    lin.reset_lineage()
+    tp.adopt_lineage(hdr)
+    g = lin.get_lineage()
+    assert g.enabled and g.k == 2 and g.salt == 99
+
+
+def test_lineage_tag_codec_roundtrip():
+    from clonos_tpu.causal import serde
+
+    tags = [(100, 2, 7, 1, 3), (5, 0, 0, 0, 0)]
+    frame = serde.encode_lineage_tags(tags)
+    assert serde.decode_lineage_tags(frame) == tags
+    with pytest.raises(ValueError):
+        serde.decode_lineage_tags(frame[:-1] + b"\x00")
+
+
+# --- observation files -------------------------------------------------------
+
+
+def test_read_observations_tolerates_torn_tail(tmp_path):
+    p = lin.LineagePlane(str(tmp_path), service="t", k=2)
+    p.observe_epoch(0, {"logs": {}, "rings": {
+        0: [([3, 5], [1, 1], [0, 1])]}})
+    p.close()
+    (path,) = [str(tmp_path / f) for f in os.listdir(tmp_path)]
+    n = len(lin.read_observations(path))
+    assert n > 0
+    with open(path, "a") as f:
+        f.write('{"kind": "hop", "torn')       # SIGKILL mid-append
+    assert len(lin.read_observations([path])) == n
+    # mid-file corruption is damage, not a torn tail
+    with open(path, "a") as f:
+        f.write('\n{"kind": "dye", "key": 3}\n')
+    with pytest.raises(ValueError):
+        lin.read_observations(path)
+
+
+def test_observe_epoch_is_idempotent(tmp_path):
+    p = lin.LineagePlane(str(tmp_path), service="t", k=2)
+    win = {"logs": {}, "rings": {0: [([3, 5], [1, 1], [0, 1])]}}
+    n1 = p.observe_epoch(4, win)
+    assert p.observe_epoch(4, win) == 0, \
+        "a recovery-replayed fence must not double-observe"
+    p.close()
+    (path,) = [str(tmp_path / f) for f in os.listdir(tmp_path)]
+    assert len(lin.read_observations(path)) == n1
+
+
+def test_self_check_clean():
+    assert lin.lineage_self_check() == []
+
+
+# --- live capture + reconstruction ------------------------------------------
+
+
+def _make_runner(tmp_path, plane, seed=3):
+    from clonos_tpu.api.environment import StreamEnvironment
+    from clonos_tpu.runtime.cluster import ClusterRunner
+
+    env = StreamEnvironment(name="linjob", num_key_groups=16)
+    (env.synthetic_source(vocab=11, batch_size=8, parallelism=2)
+        .key_by()
+        .window_count(num_keys=11, window_size=1 << 30, name="w"))
+    return ClusterRunner(env.build(), steps_per_epoch=4,
+                         log_capacity=256, max_epochs=8,
+                         inflight_ring_steps=16, seed=seed,
+                         checkpoint_dir=str(tmp_path / "ck"),
+                         lineage=plane)
+
+
+def test_cluster_fence_observes_dyed_records(tmp_path):
+    plane = lin.LineagePlane(str(tmp_path), service="run", k=3)
+    r = _make_runner(tmp_path, plane)
+    for _ in range(3):
+        r.run_epoch(complete_checkpoint=True)
+    r.drain_fence()
+    plane.close()
+    assert plane.dyed > 0 and plane.observations > plane.dyed
+    # the lineage.* gauges landed in the runner registry
+    snap = r.metrics.snapshot()
+    assert snap["lineage.dyed"] == plane.dyed
+    assert snap["lineage.epochs-observed"] == 3
+    assert snap["lineage.k"] == 3
+
+    obs = lin.read_observations(str(tmp_path / "lineage-run.jsonl"))
+    rep = lin.reconstruct(obs)
+    assert rep["ok"] is True and rep["broken_keys"] == []
+    assert len(rep["keys"]) >= 3
+    for path in rep["keys"].values():
+        assert path["dyed_at"] is not None
+        assert path["hops"], "a dyed record must have ring hops"
+        assert path["determinants"], \
+            "hops must carry ORDER/TIMESTAMP/RNG context"
+        # hop attribution: key-group routing resolved to a subtask
+        assert all("subtask" in h and "key_group" in h
+                   for h in path["hops"])
+
+
+def test_trace_byte_identical_across_two_processes(tmp_path):
+    plane = lin.LineagePlane(str(tmp_path), service="run", k=3)
+    r = _make_runner(tmp_path, plane)
+    for _ in range(2):
+        r.run_epoch(complete_checkpoint=True)
+    r.drain_fence()
+    plane.close()
+    path = str(tmp_path / "lineage-run.jsonl")
+
+    a = _cli_lineage(path, "--report", "json")
+    b = _cli_lineage(path, "--report", "json")
+    assert a.returncode == 0, a.stderr
+    assert b.returncode == 0, b.stderr
+    assert a.stdout and a.stdout == b.stdout, \
+        "two fresh processes must render identical bytes"
+    rep = json.loads(a.stdout)
+    assert rep["ok"] is True
+    assert rep["schema_fingerprint"] == lin.lineage_schema_fingerprint()
+    # --key narrows to one record, same canonical encoding
+    key = sorted(rep["keys"], key=int)[0]
+    k1 = _cli_lineage(path, "--key", key, "--report", "json")
+    k2 = _cli_lineage(path, "--key", key, "--report", "json")
+    assert k1.returncode == 0 and k1.stdout == k2.stdout
+
+
+def test_cli_self_check_and_chrome_export(tmp_path):
+    out = _cli_lineage("--self-check")
+    assert out.returncode == 0, out.stderr
+    line = json.loads(out.stdout)
+    assert line["ok"] is True and line["findings"] == []
+
+    plane = lin.LineagePlane(str(tmp_path), service="run", k=2)
+    r = _make_runner(tmp_path, plane)
+    r.run_epoch(complete_checkpoint=True)
+    r.drain_fence()
+    plane.close()
+    dst = str(tmp_path / "chrome.json")
+    out = _cli_lineage(str(tmp_path / "lineage-run.jsonl"),
+                       "--chrome", dst)
+    assert out.returncode == 0, out.stderr
+    doc = json.load(open(dst))
+    assert doc["traceEvents"]
+
+
+# --- serve-read terminus + provenance stamp ----------------------------------
+
+
+def test_serve_reads_carry_provenance_and_feed_lineage(tmp_path):
+    from clonos_tpu.api.environment import StreamEnvironment
+    from clonos_tpu.runtime.cluster import ClusterRunner
+    from clonos_tpu.runtime.serve import build_serve_tier
+
+    plane = lin.LineagePlane(str(tmp_path), service="serve", k=4)
+    env = StreamEnvironment(name="serve", num_key_groups=16,
+                            default_edge_capacity=64)
+    (env.synthetic_source(vocab=11, batch_size=8, parallelism=2)
+        .key_by().reduce(num_keys=11, name="r").sink())
+    r = ClusterRunner(env.build(), steps_per_epoch=4,
+                      log_capacity=256, max_epochs=8,
+                      inflight_ring_steps=16, seed=3, lineage=plane)
+    tier = build_serve_tier(r, 1, n_replicas=1)
+    try:
+        r.run_epoch(complete_checkpoint=True)
+        r.drain_fence()
+        dyed = sorted(plane._dyed_recent)
+        assert dyed, "an epoch must have dyed records"
+        out = tier.router.query(1, dyed[0])
+        # provenance stamp: who served, at which fence, rerouted?
+        assert out["replica"] == "replica-0"
+        assert out["rerouted"] is False
+        assert out["epoch"] >= 0
+        # the endpoint itself stamps its identity too (direct reads)
+        rep = tier.clients[0].query(1, dyed[0])
+        assert rep["replica"] == "replica-0"
+        batch = tier.router.query_batch(1, [0, 1, 2])
+        assert batch["rerouted"] == [False, False, False]
+        before = plane.serve_hits
+        assert before >= 1, "dyed reads must land serve observations"
+        tier.router.query(1, dyed[0])
+        assert plane.serve_hits == before + 1
+    finally:
+        tier.close()
+        plane.close()
+    obs = lin.read_observations(str(tmp_path / "lineage-serve.jsonl"))
+    serves = [o for o in obs if o["kind"] == "serve"]
+    assert serves and any(o["key"] == dyed[0] for o in serves)
+    path = lin.reconstruct(obs)["keys"][str(dyed[0])]
+    assert path["serves"] and path["broken"] == []
+
+
+# --- the headline acceptance (slow) ------------------------------------------
+
+
+@pytest.mark.slow
+def test_soak_lineage_paths_bit_identical_across_kill(tmp_path):
+    """The headline proof: arm lineage on a soak fixture, kill a
+    subtask mid-run, recover, and the dyed records' reconstructed
+    end-to-end paths must come out BYTE-identical to the fault-free
+    control twin's — recovery replayed the dyed records through the
+    exact same hops, determinants and termini."""
+    from clonos_tpu.soak import build_soak_fixture
+    from clonos_tpu.soak.driver import default_kill_targets
+
+    lin.configure_lineage(str(tmp_path), service="soak", k=4)
+    runner, control, election = build_soak_fixture(
+        str(tmp_path), rate=1200.0, duration_s=4.0,
+        steps_per_epoch=32, seed=11)
+    try:
+        assert runner.lineage is not control.lineage
+        assert runner.lineage.enabled and control.lineage.enabled
+        assert runner.lineage.salt == control.lineage.salt
+
+        for e in range(6):
+            runner.run_epoch(complete_checkpoint=True)
+            control.run_epoch(complete_checkpoint=True)
+            if e == 2:      # mid-soak kill on the live runner only
+                runner.drain_fence()
+                runner.inject_failure(default_kill_targets(runner.job))
+                runner.recover()
+        runner.drain_fence()
+        control.drain_fence()
+        # both twins dyed the SAME records, zero coordination
+        assert runner.lineage.dyed == control.lineage.dyed > 0
+    finally:
+        runner.lineage.close()
+        control.lineage.close()
+
+    run_f = str(tmp_path / "lineage-soak-run.jsonl")
+    ctl_f = str(tmp_path / "lineage-soak-control.jsonl")
+    a = _cli_lineage(run_f, "--report", "json")
+    b = _cli_lineage(ctl_f, "--report", "json")
+    assert a.returncode == 0, a.stderr
+    assert b.returncode == 0, b.stderr
+    assert a.stdout == b.stdout, \
+        "faulted path must replay bit-identical to the fault-free twin"
+    rep = json.loads(a.stdout)
+    assert rep["ok"] is True and len(rep["keys"]) > 0
+    # the joined view across BOTH twins also reconstructs cleanly
+    both = _cli_lineage(run_f, ctl_f, "--report", "json")
+    assert both.returncode == 0
+    assert json.loads(both.stdout)["ok"] is True
