@@ -37,7 +37,7 @@ from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from clonos_tpu.lint.core import ERROR, WARNING, FileContext, Finding, \
     Rule, register_rule
-from clonos_tpu.lint.concurrency import _lock_attr
+from clonos_tpu.lint.concurrency import _lock_attr, lock_attrs
 
 from clonos_tpu.analysis.callgraph import CallGraph, FunctionInfo
 
@@ -126,7 +126,13 @@ class LockOrderGraph:
         # self.<attr>:` — lets a lock reached through an untyped
         # parameter unify with its owner when the name is unambiguous.
         self._lock_owners: Dict[str, Set[str]] = {}
+        # Type-proven lock attributes per file (`self._cv =
+        # threading.Condition()`): extends the name hints so the lock
+        # identity the race pass reuses matches the lint's guard set.
+        self._known_locks: Dict[str, frozenset] = {
+            c.path: lock_attrs(c) for c in contexts}
         for c in contexts:
+            known = self._known_locks[c.path]
             for node in ast.walk(c.tree):
                 if not isinstance(node, ast.ClassDef):
                     continue
@@ -139,7 +145,7 @@ class LockOrderGraph:
                             and sub.func.attr == "acquire":
                         exprs = [sub.func.value]
                     for e in exprs:
-                        attr = _lock_attr(e)
+                        attr = _lock_attr(e, known)
                         if attr is not None \
                                 and isinstance(e, ast.Attribute) \
                                 and isinstance(e.value, ast.Name) \
@@ -196,7 +202,8 @@ class LockOrderGraph:
         -> ``JobMaster._lock`` when ``self.jm``'s class is known; a lock
         reached through a parameter resolves via its annotation, else
         via attribute-name uniqueness across the repo's classes."""
-        attr = _lock_attr(expr)
+        attr = _lock_attr(
+            expr, self._known_locks.get(ctx.path, frozenset()))
         if attr is None:
             return None
         owner = "?"

@@ -13,6 +13,16 @@ call graph, so they cannot run per-file from ``run_lint``:
   a helper" and "this block program replays differently".
 - **lock-order** (ERROR): acquisition-order cycles in the whole-repo
   lock graph (analysis/lockorder.py).
+- **thread-race** / **join-discipline** (ERROR): lockset ∩
+  happens-before race detection over the thread-root inventory
+  (analysis/threads.py, analysis/races.py) — shared attributes touched
+  by two roots with a write and disjoint guards, and reads of a
+  worker's product not dominated by a join.
+
+The thread-root census (analysis/threads.py) rides along next to the
+FT-call-site census, fingerprinted, so CI can pin the concurrency
+architecture (`.clonos-threads`) the same way it pins the call-site
+population (`.clonos-census`).
 
 The census (analysis/census.py) rides along in the result and the JSON
 report, fingerprinted, so CI and the bench artifacts agree on exactly
@@ -39,11 +49,15 @@ from clonos_tpu.analysis import census as census_mod
 from clonos_tpu.analysis.callgraph import CallGraph
 from clonos_tpu.analysis.lockorder import (LOCK_BALANCE, LOCK_ORDER,
                                            LockOrderGraph)
+from clonos_tpu.analysis import threads as threads_mod
+from clonos_tpu.analysis.races import (JOIN_DISCIPLINE, THREAD_RACE,
+                                       run_races)
 
 NONDET_REACH = "nondet-reach"
 
 #: rules this runner owns (waiver staleness is scoped to these).
-ANALYSIS_RULES = {NONDET_REACH, LOCK_ORDER, LOCK_BALANCE}
+ANALYSIS_RULES = {NONDET_REACH, LOCK_ORDER, LOCK_BALANCE,
+                  THREAD_RACE, JOIN_DISCIPLINE}
 
 #: per-file rules whose unwaived findings seed the reach propagation.
 TAINT_RULES = ("wallclock", "rng", "entropy")
@@ -74,6 +88,8 @@ class AnalysisResult:
     files: List[str]
     census: Dict
     census_fingerprint: str
+    threads: Dict = dataclasses.field(default_factory=dict)
+    threads_fingerprint: str = ""
 
     @property
     def errors(self) -> List[Finding]:
@@ -104,10 +120,12 @@ class AnalysisResult:
             "warnings": len(self.warnings),
             "waived": len(self.waived),
             "census_fingerprint": self.census_fingerprint,
+            "threads_fingerprint": self.threads_fingerprint,
             "findings": [f.to_dict() for f in self.findings],
         }
         if with_census:
             out["census"] = self.census
+            out["threads"] = self.threads
         return out
 
 
@@ -144,7 +162,11 @@ def run_analysis(paths: Sequence[str] = ("clonos_tpu", "examples"),
     graph = CallGraph(prog_ctxs)
 
     findings.extend(_nondet_reach(prog_ctxs, graph, ws, use_waivers))
-    findings.extend(LockOrderGraph(prog_ctxs, graph).findings())
+    lockgraph = LockOrderGraph(prog_ctxs, graph)
+    findings.extend(lockgraph.findings())
+
+    inventory = threads_mod.ThreadInventory(prog_ctxs, graph)
+    findings.extend(run_races(prog_ctxs, graph, lockgraph, inventory))
 
     census = census_mod.build_census(prog_ctxs, graph)
 
@@ -158,7 +180,10 @@ def run_analysis(paths: Sequence[str] = ("clonos_tpu", "examples"),
     return AnalysisResult(findings=findings, files=files,
                           census=census,
                           census_fingerprint=census_mod.fingerprint(
-                              census))
+                              census),
+                          threads=inventory.to_dict(),
+                          threads_fingerprint=threads_mod.fingerprint(
+                              inventory))
 
 
 def _nondet_reach(contexts: Sequence[FileContext], graph: CallGraph,
@@ -250,7 +275,9 @@ def format_text(result: AnalysisResult, verbose: bool = False) -> str:
         f"{result.census_fingerprint} "
         f"({len(c['step_functions'])} step fn(s), "
         f"{len(c['service_call_sites'])} service call site(s), "
-        f"{c['dets_per_step']} sync lanes/step)")
+        f"{c['dets_per_step']} sync lanes/step); threads "
+        f"{result.threads_fingerprint} "
+        f"({len(result.threads.get('roots', []))} root(s))")
     return "\n".join(lines)
 
 

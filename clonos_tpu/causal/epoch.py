@@ -95,6 +95,10 @@ class EpochTracker:
     def start_new_epoch(self, epoch_id: int) -> None:
         self.epoch_id = epoch_id
         self.record_count = 0
+        # clonos: allow(join-discipline): listeners are registered during
+        # wiring, before any worker thread exists (pre-start publication
+        # across functions, which the race pass only models within the
+        # spawning function); the list is never mutated after start.
         for fn in self._epoch_listeners:
             fn(epoch_id)
         # A replay target at record count 0 (first event of the new epoch)
@@ -114,6 +118,9 @@ class EpochTracker:
         self._seal_listeners.append(fn)
 
     def notify_epoch_sealed(self, epoch_id: int, digest: object) -> None:
+        # clonos: allow(join-discipline): seal listeners are registered
+        # during wiring, before the fence worker starts (pre-start
+        # publication across functions); never mutated after start.
         for fn in self._seal_listeners:
             fn(epoch_id, digest)
 
@@ -137,6 +144,11 @@ class EpochTracker:
         entry = (e, target, self._seq, det, callback)
         self._seq += 1
         # seq is unique, so tuple comparison never reaches the determinant.
+        # clonos: allow(join-discipline): record-count targets register
+        # and fire on the step thread only — inc_record_count is never
+        # called from the fence tail (the reach chain the race pass
+        # reports goes through cluster helpers the tail shares but does
+        # not execute); replay installation runs with the tail joined.
         bisect.insort(self._targets, entry)
         # Fire immediately if already due (reference setRecordCountTarget:111
         # fires when recordCount == target at registration).

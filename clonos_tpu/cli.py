@@ -780,18 +780,49 @@ def cmd_analyze(args) -> int:
     """Whole-program static analysis (``clonos_tpu analyze``): the
     interprocedural passes the per-file lint cannot run — nondet-escape
     propagation to step functions, the whole-repo lock-order cycle
-    check, and the FT census + static cost model (analysis/). Same
-    waiver file, same ``--report json`` one-liner, same 0/1 exit
-    convention as the lint. Jax-free: runnable from any CI box."""
+    check, the thread-root race detector, and the FT census + static
+    cost model (analysis/). Same waiver file, same ``--report json``
+    one-liner, same 0/1 exit convention as the lint. Jax-free:
+    runnable from any CI box."""
     from clonos_tpu import analysis as _an
+
+    if args.seed_bug is not None:
+        # Self-test: the seeded-bug registry must make its rule bite.
+        if args.seed_bug not in _an.SEEDED_BUGS:
+            known = ", ".join(sorted(_an.SEEDED_BUGS))
+            print(f"unknown seeded bug {args.seed_bug!r} "
+                  f"(known: {known})", file=sys.stderr)
+            return 2
+        findings = _an.seeded_findings(args.seed_bug)
+        for f in findings:
+            print(f"{f.location()}: [{f.rule}] {f.message}")
+        if not findings:
+            print(f"seeded bug {args.seed_bug!r} produced NO finding "
+                  f"— the race detector lost its teeth",
+                  file=sys.stderr)
+            return 2
+        return 1        # the bug was detected, as it must be
 
     result = _an.run_analysis(args.paths, waiver_file=args.waivers,
                               use_waivers=not args.no_waivers)
+    if args.races:
+        # Restrict the report and the exit code to the race pass.
+        race_rules = {_an.THREAD_RACE, _an.JOIN_DISCIPLINE}
+        kept = [f for f in result.findings
+                if f.rule in race_rules
+                or any(r in f.message for r in race_rules)]
+        result = _an.AnalysisResult(
+            findings=kept, files=result.files, census=result.census,
+            census_fingerprint=result.census_fingerprint,
+            threads=result.threads,
+            threads_fingerprint=result.threads_fingerprint)
     if args.report == "json":
         # CI convention: one machine-readable line, exit 0/1.
         print(_an.format_json(result, with_census=not args.no_census))
     elif args.census:
         print(json.dumps(result.census, indent=2, sort_keys=True))
+    elif args.threads:
+        print(json.dumps(result.threads, indent=2, sort_keys=True))
     else:
         print(_an.format_text(result, verbose=args.verbose))
     rc = result.exit_code()
@@ -808,6 +839,21 @@ def cmd_analyze(args) -> int:
                   f"the FT call-site population changed; review "
                   f"`clonos_tpu analyze --census` and re-pin the "
                   f"fingerprint", file=sys.stderr)
+            rc = max(rc, 1)
+    if args.expect_threads is not None:
+        expect = args.expect_threads
+        if os.path.isfile(expect):
+            # a pin file (.clonos-threads): first token is the pin
+            with open(expect) as f:
+                toks = f.read().split()
+            expect = toks[0] if toks else ""
+        if result.threads_fingerprint != expect:
+            print(f"thread-census drift: fingerprint "
+                  f"{result.threads_fingerprint} != pinned {expect} — "
+                  f"the thread-root population changed (a thread was "
+                  f"added, removed, or re-homed); review "
+                  f"`clonos_tpu analyze --threads` and re-pin the "
+                  f"fingerprint in .clonos-threads", file=sys.stderr)
             rc = max(rc, 1)
     return rc
 
@@ -2067,6 +2113,22 @@ def main(argv=None) -> int:
                          "census fingerprint equals FP — a hex "
                          "fingerprint or a pin file like "
                          "./.clonos-census whose first token is one")
+    pa.add_argument("--races", action="store_true",
+                    help="restrict the report and exit code to the "
+                         "race pass (thread-race / join-discipline)")
+    pa.add_argument("--threads", action="store_true",
+                    help="print the thread-root inventory as indented "
+                         "JSON instead of the findings")
+    pa.add_argument("--expect-threads", default=None, metavar="FP",
+                    help="thread-census drift gate: fail (exit 1) "
+                         "unless the thread-root fingerprint equals FP "
+                         "— a hex fingerprint or a pin file like "
+                         "./.clonos-threads whose first token is one")
+    pa.add_argument("--seed-bug", default=None, metavar="NAME",
+                    help="self-test: run the race pass on a seeded "
+                         "concurrency bug (drop-a-join, unguarded-"
+                         "cross-thread-write, queue-bypass) — must "
+                         "exit 1 with the minimal counterexample")
     pa.set_defaults(fn=cmd_analyze)
 
     pv = sub.add_parser("verify",

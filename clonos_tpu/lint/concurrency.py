@@ -33,6 +33,15 @@ from clonos_tpu.lint.core import (FileContext, Finding, Rule,
 #: attribute names that look like locks when used as `with self.X:`.
 _LOCK_HINT = ("lock", "mutex", "cond")
 
+#: constructor dotted names that make an attribute a lock regardless of
+#: what it is called — `self._cv = threading.Condition()` guards state
+#: exactly like `self._lock` does, and the race pass must agree with
+#: the lint on that.
+LOCK_TYPES = {
+    "threading.Lock", "threading.RLock", "threading.Condition",
+    "threading.Semaphore", "threading.BoundedSemaphore",
+}
+
 #: method names whose call mutates the receiver.
 MUTATING_METHODS = {
     "append", "extend", "add", "update", "insert", "remove", "discard",
@@ -45,13 +54,35 @@ EXEMPT_METHODS = {"__init__", "__new__", "__enter__", "__del__",
                   "__repr__", "__str__"}
 
 
-def _lock_attr(node: ast.AST) -> Optional[str]:
+def _lock_attr(node: ast.AST,
+               known: frozenset = frozenset()) -> Optional[str]:
     """`self._writer_lock` (possibly through one hop like
-    `self.jm._lock`) used as a context manager -> its attribute name."""
+    `self.jm._lock`) used as a context manager -> its attribute name.
+    ``known`` extends the name hints with attributes proven to be locks
+    by their constructor type (:func:`lock_attrs`)."""
     if isinstance(node, ast.Attribute) \
-            and any(h in node.attr.lower() for h in _LOCK_HINT):
+            and (any(h in node.attr.lower() for h in _LOCK_HINT)
+                 or node.attr in known):
         return node.attr
     return None
+
+
+def lock_attrs(ctx: FileContext) -> frozenset:
+    """Attribute names assigned a :data:`LOCK_TYPES` constructor
+    anywhere in the file (``self._cv = threading.Condition()``) — the
+    type-based half of guard recognition, feeding :func:`_lock_attr`'s
+    ``known`` set so oddly-named guards still count."""
+    out = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Assign) \
+                and isinstance(node.value, ast.Call):
+            dotted = ctx.resolve(node.value.func)
+            if dotted in LOCK_TYPES:
+                for t in node.targets:
+                    a = _self_attr(t)
+                    if a is not None:
+                        out.add(a)
+    return frozenset(out)
 
 
 def _self_attr(node: ast.AST) -> Optional[str]:
@@ -69,10 +100,12 @@ class _MethodScan:
     """Per-method facts: mutations split by lock-held/not, whether the
     method ever takes a lock, and intra-class calls made outside locks."""
 
-    def __init__(self, cls_name: str, fn: ast.FunctionDef):
+    def __init__(self, cls_name: str, fn: ast.FunctionDef,
+                 known: frozenset = frozenset()):
         self.cls_name = cls_name
         self.fn = fn
         self.name = fn.name
+        self.known = known
         #: attr -> [lineno] mutated while a lock is held
         self.locked_mut: Dict[str, List[int]] = {}
         #: attr -> [(lineno, verb)] mutated with no lock held
@@ -82,29 +115,58 @@ class _MethodScan:
         self.unlocked_calls: Set[str] = set()
         self._walk(fn.body, depth=0)
 
-    def _walk(self, stmts, depth: int):
+    def _walk(self, stmts, depth: int) -> int:
+        # Bare `self._lock.acquire()` / `.release()` statements adjust
+        # the depth for SUBSEQUENT statements, so `acquire()` +
+        # try/finally-`release()` counts as a locked region exactly
+        # like `with self._lock:` does.
         for stmt in stmts:
-            self._visit(stmt, depth)
+            depth = self._visit(stmt, depth)
+        return depth
 
-    def _visit(self, node: ast.AST, depth: int):
+    def _bare_lock_verb(self, expr: ast.AST) -> Optional[str]:
+        if isinstance(expr, ast.Call) \
+                and isinstance(expr.func, ast.Attribute) \
+                and expr.func.attr in ("acquire", "release") \
+                and _lock_attr(expr.func.value,
+                               self.known) is not None:
+            return expr.func.attr
+        return None
+
+    def _visit(self, node: ast.AST, depth: int) -> int:
+        if isinstance(node, ast.Expr):
+            verb = self._bare_lock_verb(node.value)
+            if verb == "acquire":
+                self.takes_lock = True
+                return depth + 1
+            if verb == "release":
+                return max(depth - 1, 0)
         if isinstance(node, ast.With):
             inner = depth
             for item in node.items:
-                if _lock_attr(item.context_expr) is not None:
+                if _lock_attr(item.context_expr,
+                              self.known) is not None:
                     self.takes_lock = True
                     inner = depth + 1
             self._walk(node.body, inner)
-            return
+            return depth
+        if isinstance(node, ast.Try):
+            d = self._walk(node.body, depth)
+            for h in node.handlers:
+                self._walk(h.body, depth)
+            d = self._walk(node.orelse, d)
+            return self._walk(node.finalbody, d)
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
                              ast.Lambda)):
             # Nested defs run later, possibly on another thread — their
             # bodies are analysed as lock-free.
             body = node.body if isinstance(node.body, list) else [node.body]
             self._walk(body, 0)
-            return
+            return depth
         self._record(node, depth)
         for child in ast.iter_child_nodes(node):
             self._visit(child, depth)
+        return depth
 
     def _record(self, node: ast.AST, depth: int):
         attr = None
@@ -149,15 +211,16 @@ class LockDisciplineRule(Rule):
 
     def check(self, ctx: FileContext) -> List[Finding]:
         out: List[Finding] = []
+        known = lock_attrs(ctx)
         for node in ast.walk(ctx.tree):
             if isinstance(node, ast.ClassDef):
-                out.extend(self._check_class(ctx, node))
+                out.extend(self._check_class(ctx, node, known))
         return out
 
-    def _check_class(self, ctx: FileContext,
-                     cls: ast.ClassDef) -> List[Finding]:
+    def _check_class(self, ctx: FileContext, cls: ast.ClassDef,
+                     known: frozenset) -> List[Finding]:
         scans = [
-            _MethodScan(cls.name, item) for item in cls.body
+            _MethodScan(cls.name, item, known) for item in cls.body
             if isinstance(item, ast.FunctionDef)
         ]
         if not any(s.takes_lock for s in scans):
@@ -171,7 +234,8 @@ class LockDisciplineRule(Rule):
             guarded.update(s.locked_mut)
         # Lock attributes themselves are assigned, not guarded state.
         guarded = {a for a in guarded
-                   if not any(h in a.lower() for h in _LOCK_HINT)}
+                   if not any(h in a.lower() for h in _LOCK_HINT)
+                   and a not in known}
         if not guarded:
             return []
 
@@ -197,11 +261,13 @@ class LockDisciplineRule(Rule):
                                     and c not in EXEMPT_METHODS}
                 # Called intra-class, and every such call site sits
                 # inside a lock region -> treat body as lock-held.
-                called_anywhere = any(s.name in o.unlocked_calls
-                                      or self._called_locked(o, s.name)
-                                      for o in scans if o is not s)
+                called_anywhere = any(
+                    s.name in o.unlocked_calls
+                    or self._called_locked(o, s.name, known)
+                    for o in scans if o is not s)
                 if called_anywhere and not unlocked_callers \
-                        and self._only_called_locked(scans, s.name):
+                        and self._only_called_locked(scans, s.name,
+                                                     known):
                     held.add(s.name)
                     changed = True
 
@@ -223,7 +289,8 @@ class LockDisciplineRule(Rule):
         return out
 
     @staticmethod
-    def _called_locked(scan: "_MethodScan", name: str) -> bool:
+    def _called_locked(scan: "_MethodScan", name: str,
+                       known: frozenset = frozenset()) -> bool:
         """Does ``scan`` call self.<name>() from inside a lock region?"""
         found = False
 
@@ -232,7 +299,8 @@ class LockDisciplineRule(Rule):
             if isinstance(node, ast.With):
                 inner = depth
                 for item in node.items:
-                    if _lock_attr(item.context_expr) is not None:
+                    if _lock_attr(item.context_expr,
+                                  known) is not None:
                         inner = depth + 1
                 for child in node.body:
                     visit(child, inner)
@@ -250,11 +318,12 @@ class LockDisciplineRule(Rule):
             visit(stmt, 0)
         return found
 
-    def _only_called_locked(self, scans, name: str) -> bool:
+    def _only_called_locked(self, scans, name: str,
+                            known: frozenset = frozenset()) -> bool:
         any_call = False
         for o in scans:
             if name in o.unlocked_calls:
                 return False
-            if self._called_locked(o, name):
+            if self._called_locked(o, name, known):
                 any_call = True
         return any_call

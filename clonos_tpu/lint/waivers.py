@@ -95,10 +95,17 @@ class WaiverSet:
         return hit
 
     def stale(self) -> List[Finding]:
-        """WARNING findings for waivers that excused nothing."""
+        """WARNING findings for waivers that excused nothing.
+
+        Waivers naming only analysis-owned rules (nondet-reach,
+        thread-race, …) are the analysis runner's to second-guess —
+        the per-file lint never produces their findings, so from here
+        they always look unused."""
+        owned = _analysis_owned_rules()
         out: List[Finding] = []
         for w in self.inline:
-            if not w.used and not w.rules & {UNKNOWN_RULE}:
+            if not w.used and not w.rules & {UNKNOWN_RULE} \
+                    and w.rules - owned:
                 out.append(Finding(
                     rule=STALE_WAIVER, path=w.path, line=w.line,
                     severity=WARNING,
@@ -108,6 +115,8 @@ class WaiverSet:
                             f"delete the comment"))
         for e in self.entries:
             if not e.used and self.waiver_path is not None:
+                if e.rule in owned:
+                    continue
                 if e.rule == "exclude" and not self.traversed:
                     continue
                 what = ("exclude" if e.rule == "exclude"
@@ -140,6 +149,29 @@ def _comment_lines(source: str) -> List[Tuple[int, str]]:
     return out
 
 
+def _analysis_owned_rules() -> set:
+    """Rules the whole-program analysis runner owns (lazy import — see
+    :func:`_register_analysis_rules`). Empty when unavailable."""
+    try:
+        from clonos_tpu.analysis.runner import ANALYSIS_RULES
+        return set(ANALYSIS_RULES)
+    except ImportError:
+        return set()
+
+
+def _register_analysis_rules() -> None:
+    """The analysis package owns the whole-program rules (nondet-reach,
+    lock-order, thread-race, join-discipline, …) and registers them in
+    the shared registry on import. Load it lazily so waivers naming
+    those rules validate from a bare ``clonos_tpu lint`` run too — a
+    function-level import, because the analysis package imports
+    lint.core and a module-level import would cycle."""
+    try:
+        import clonos_tpu.analysis.runner  # noqa: F401
+    except ImportError:            # analysis package absent/broken:
+        pass                       # its rule names stay unknown
+
+
 def collect_inline(ctx: FileContext) -> Tuple[List[InlineWaiver],
                                               List[Finding]]:
     """Parse ``# clonos: allow(...)`` comments in one file.
@@ -148,6 +180,7 @@ def collect_inline(ctx: FileContext) -> Tuple[List[InlineWaiver],
     (a multi-line justification block above the code works); a trailing
     waiver targets its own line. Unknown rule names are ERROR
     findings."""
+    _register_analysis_rules()
     waivers: List[InlineWaiver] = []
     problems: List[Finding] = []
     for lineno, comment in _comment_lines(ctx.source):
@@ -184,6 +217,7 @@ def load_waiver_file(path: str,
     """Parse a ``.clonos-waivers`` file: ``<rule> <glob>`` /
     ``exclude <glob>`` lines, ``#`` comments. Unknown rule names are
     ERROR findings anchored to the waiver file itself."""
+    _register_analysis_rules()
     entries: List[FileWaiverEntry] = []
     problems: List[Finding] = []
     if repo_text is None:

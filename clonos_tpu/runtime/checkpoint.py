@@ -349,6 +349,12 @@ class CheckpointCoordinator:
         self._listeners: List[Callable[[CompletedCheckpoint], None]] = []
         self._complete_listeners: List[Callable[[int], None]] = []
         self._writer_lock = threading.Lock()
+        #: guards _pending/_ignored/_completed_ids/_trigger_wall/
+        #: completion_latency_s — all touched from the caller thread,
+        #: the fence worker, AND the async writer threads. Never held
+        #: while calling listeners or storage (those take _writer_lock
+        #: or arbitrary user code).
+        self._state_lock = threading.Lock()
         self._async_threads: List[threading.Thread] = []
         #: transition observers: ``fn(kind, **fields)`` on every
         #: protocol-visible transition (trigger/ack/complete/ignore/
@@ -389,12 +395,14 @@ class CheckpointCoordinator:
         otherwise device-kept storage defensively copies — the executor
         donates its live carry into later programs, which would delete
         referenced buffers out from under the checkpoint."""
-        if checkpoint_id in self._ignored:
-            return
-        self._pending[checkpoint_id] = set(range(self.num_subtasks))
+        with self._state_lock:
+            if checkpoint_id in self._ignored:
+                return
+            self._pending[checkpoint_id] = set(
+                range(self.num_subtasks))
+            # clonos: allow(wallclock): trigger->complete latency metric
+            self._trigger_wall[checkpoint_id] = time.time()
         self._observe("trigger", cid=checkpoint_id)
-        # clonos: allow(wallclock): trigger->complete latency metric only
-        self._trigger_wall[checkpoint_id] = time.time()
         get_tracer().event("checkpoint.trigger", cid=checkpoint_id,
                            subtasks=self.num_subtasks)
         snap_start = time.monotonic()
@@ -407,6 +415,10 @@ class CheckpointCoordinator:
                 lambda x: jnp.asarray(x).copy(), carry)
 
         def _write():
+            # clonos: allow(join-discipline): `storage` is assigned once
+            # at construction and never rebound; every mutation OF the
+            # storage object holds _writer_lock, and this bare deref
+            # only reads the immutable wants_host capability flag.
             host = (carry_to_host(carry) if self.storage.wants_host
                     else carry)
             ckpt = CompletedCheckpoint(
@@ -428,22 +440,26 @@ class CheckpointCoordinator:
         self._maybe_complete(checkpoint_id)
 
     def ack(self, checkpoint_id: int, subtask: int) -> None:
-        missing = self._pending.get(checkpoint_id)
-        if missing is not None:
+        with self._state_lock:
+            missing = self._pending.get(checkpoint_id)
+            if missing is None:
+                return
             missing.discard(subtask)
-            self._observe("ack", cid=checkpoint_id, subtask=subtask)
-            self._maybe_complete(checkpoint_id)
+        self._observe("ack", cid=checkpoint_id, subtask=subtask)
+        self._maybe_complete(checkpoint_id)
 
     def ack_all(self, checkpoint_id: int,
                 except_subtasks: Tuple[int, ...] = ()) -> None:
-        missing = self._pending.get(checkpoint_id)
-        if missing is not None:
+        with self._state_lock:
+            missing = self._pending.get(checkpoint_id)
+            if missing is None:
+                return
             acked = missing - set(except_subtasks)
             missing.intersection_update(except_subtasks)
-            for subtask in sorted(acked):
-                self._observe("ack", cid=checkpoint_id,
-                              subtask=subtask)
-            self._maybe_complete(checkpoint_id)
+        for subtask in sorted(acked):
+            self._observe("ack", cid=checkpoint_id,
+                          subtask=subtask)
+        self._maybe_complete(checkpoint_id)
 
     def discard_pending_through(self, checkpoint_id: int) -> List[int]:
         """Abandon every pending checkpoint at or below
@@ -456,71 +472,90 @@ class CheckpointCoordinator:
         determinants land in healthy logs and the digest chain stays
         byte-comparable with a fault-free control run. Returns the
         abandoned ids."""
-        # Snapshot the keys: with the pipelined fence the worker thread
-        # may trigger() a NEWER checkpoint concurrently — always above
-        # ``checkpoint_id``, so the result is unaffected, but iterating
-        # the live dict would race the insert.
-        cids = sorted(c for c in list(self._pending)
-                      if c <= checkpoint_id)
+        # The state lock closes the window the old key-snapshot comment
+        # hedged around: with the pipelined fence the worker thread may
+        # trigger() a NEWER checkpoint concurrently — always above
+        # ``checkpoint_id``, so the result is unaffected.
+        with self._state_lock:
+            cids = sorted(c for c in list(self._pending)
+                          if c <= checkpoint_id)
+            for cid in cids:
+                self._ignored.add(cid)
+                del self._pending[cid]
         for cid in cids:
-            self._ignored.add(cid)
-            del self._pending[cid]
             self._observe("discard", cid=cid)
         return cids
 
     def _maybe_complete(self, checkpoint_id: int) -> None:
-        missing = self._pending.get(checkpoint_id)
-        if missing:
-            return
+        with self._state_lock:
+            if self._pending.get(checkpoint_id):
+                return
         try:
             with self._writer_lock:
                 ckpt = self.storage.read(checkpoint_id)
         except (KeyError, FileNotFoundError):
             return  # write not durable yet; _on_written will retry
-        if checkpoint_id in self._pending:
+        # The atomic check-and-remove elects exactly one completer:
+        # _maybe_complete runs on the caller thread, the fence worker,
+        # AND the async writer thread, and a double pop here would fire
+        # every completion listener twice.
+        with self._state_lock:
+            if checkpoint_id not in self._pending:
+                return
             del self._pending[checkpoint_id]
             self._completed_ids.append(checkpoint_id)
-            self._observe("complete", cid=checkpoint_id)
-            # mark_complete rewrites storage metadata; every other
-            # storage mutation (write/delete/compact_ledger) holds
-            # _writer_lock, and _maybe_complete runs on both the async
-            # writer thread and the caller thread. The ledger group
-            # commit settles first: a durable completion marker must
-            # never outrun the sealed entries it certifies.
-            with self._writer_lock:
-                self.storage.flush_ledger()
-                try:
-                    self.storage.mark_complete(checkpoint_id)
-                except NotImplementedError:      # custom storages
-                    pass
-            tr = get_tracer()
             trig = self._trigger_wall.pop(checkpoint_id, None)
-            if trig is not None:
-                # clonos: allow(wallclock): completion latency metric
-                lat = time.time() - trig
+        self._observe("complete", cid=checkpoint_id)
+        # mark_complete rewrites storage metadata; every other
+        # storage mutation (write/delete/compact_ledger) holds
+        # _writer_lock. The ledger group commit settles first: a
+        # durable completion marker must never outrun the sealed
+        # entries it certifies.
+        with self._writer_lock:
+            self.storage.flush_ledger()
+            try:
+                self.storage.mark_complete(checkpoint_id)
+            except NotImplementedError:      # custom storages
+                pass
+        tr = get_tracer()
+        if trig is not None:
+            # clonos: allow(wallclock): completion latency metric
+            lat = time.time() - trig
+            with self._state_lock:
                 self.completion_latency_s[checkpoint_id] = lat
                 while len(self.completion_latency_s) > 64:
                     del self.completion_latency_s[
                         min(self.completion_latency_s)]
-                tr.complete("checkpoint", lat, cid=checkpoint_id,
-                            size_bytes=ckpt.size_bytes)
-            for fn in self._complete_listeners:
-                fn(checkpoint_id)
-            tr.event("checkpoint.truncate", cid=checkpoint_id)
-            for fn in self._listeners:
-                fn(ckpt)
-            self._retain()
-            # Completion == truncation time: collapse re-sealed ledger
-            # duplicates below this fence so the ledger stays one line
-            # per epoch for the life of the job.
-            with self._writer_lock:
-                self.storage.compact_ledger(checkpoint_id)
+            tr.complete("checkpoint", lat, cid=checkpoint_id,
+                        size_bytes=ckpt.size_bytes)
+        # clonos: allow(join-discipline): completion listeners are
+        # registered during wiring, before the fence/writer threads
+        # start (pre-start publication across functions, which the race
+        # pass only models within the spawning function); the list is
+        # append-only and never mutated after start.
+        for fn in self._complete_listeners:
+            fn(checkpoint_id)
+        tr.event("checkpoint.truncate", cid=checkpoint_id)
+        # clonos: allow(join-discipline): truncation listeners are
+        # registered during wiring, before any worker thread exists;
+        # append-only, never mutated after start.
+        for fn in self._listeners:
+            fn(ckpt)
+        self._retain()
+        # Completion == truncation time: collapse re-sealed ledger
+        # duplicates below this fence so the ledger stays one line
+        # per epoch for the life of the job.
+        with self._writer_lock:
+            self.storage.compact_ledger(checkpoint_id)
 
     def _retain(self) -> None:
-        while len(self._completed_ids) > self.max_retained:
-            old = self._completed_ids.pop(0)
+        with self._state_lock:
+            old = []
+            while len(self._completed_ids) > self.max_retained:
+                old.append(self._completed_ids.pop(0))
+        for cid in old:
             with self._writer_lock:
-                self.storage.delete(old)
+                self.storage.delete(cid)
 
     def drain(self) -> None:
         for t in self._async_threads:
@@ -546,17 +581,25 @@ class CheckpointCoordinator:
 
     # --- failure-path hooks --------------------------------------------------
 
+    def mark_ignored(self, checkpoint_ids) -> None:
+        """Adopt replayed IGNORE_CHECKPOINT determinants (standby
+        bootstrap): these ids must never trigger or complete here."""
+        with self._state_lock:
+            self._ignored.update(checkpoint_ids)
+
     def ignore_unacked_for(self, failed_subtasks: Set[int]) -> List[int]:
         """A task died: any pending checkpoint still missing one of its acks
         can never complete — mark ignored so healthy tasks skip it
         (reference rpcIgnoreUnacknowledgedPendingCheckpointsFor :989).
         Returns the ignored checkpoint ids (to be broadcast as
         IGNORE_CHECKPOINT determinants)."""
-        dead = [cid for cid, missing in self._pending.items()
-                if missing & failed_subtasks]
+        with self._state_lock:
+            dead = [cid for cid, missing in self._pending.items()
+                    if missing & failed_subtasks]
+            for cid in dead:
+                self._ignored.add(cid)
+                del self._pending[cid]
         for cid in dead:
-            self._ignored.add(cid)
-            del self._pending[cid]
             self._observe("ignore", cid=cid)
         return sorted(dead)
 
@@ -579,10 +622,15 @@ class CheckpointCoordinator:
 
     @property
     def latest_completed_id(self) -> Optional[int]:
-        return self._completed_ids[-1] if self._completed_ids else None
+        with self._state_lock:
+            return (self._completed_ids[-1]
+                    if self._completed_ids else None)
 
     def latest_completed(self) -> Optional[CompletedCheckpoint]:
-        if not self._completed_ids:
+        with self._state_lock:
+            cid = (self._completed_ids[-1]
+                   if self._completed_ids else None)
+        if cid is None:
             return None
         with self._writer_lock:
-            return self.storage.read(self._completed_ids[-1])
+            return self.storage.read(cid)

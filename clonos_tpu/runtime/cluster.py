@@ -514,7 +514,11 @@ class ClusterRunner:
         #: checkpoint id -> np [L] log heads at that fence, harvested from
         #: the per-epoch health read (recovery's patch phase reads them
         #: here instead of round-tripping the device on the failure path).
+        #: Inserted by the fence tail (worker thread when pipelined),
+        #: pruned by the completion hook (async writer thread), read by
+        #: recovery — every touch holds _ck_heads_lock.
         self._ck_log_heads: Dict[int, np.ndarray] = {}
+        self._ck_heads_lock = threading.Lock()
         #: host mirror of the in-flight ring offsets: heads advance one
         #: per superstep (== global_step), tails move only at checkpoint
         #: completion (ifl.truncate to the completed epoch's end fence).
@@ -740,8 +744,12 @@ class ClusterRunner:
             self._ring_tail_mirror = max(self._ring_tail_mirror, f)
         # Recovery only ever restores from the latest completed
         # checkpoint — drop older fence-head entries (bounded ledger).
-        self._ck_log_heads = {k: v for k, v in self._ck_log_heads.items()
-                              if k >= completed_epoch}
+        # Under the lock: this hook runs on the async writer thread
+        # while the fence tail inserts the next epoch's heads.
+        with self._ck_heads_lock:
+            self._ck_log_heads = {
+                k: v for k, v in self._ck_log_heads.items()
+                if k >= completed_epoch}
 
     def _ring_chunk_fn(self, ri: int, m: int):
         return self._jitted(("ring_chunk", ri, m), lambda: (
@@ -1035,7 +1043,7 @@ class ClusterRunner:
                 f"checkpoint in {checkpoint_dir}")
         ckpt = storage.read(max(ids))
         runner.standbys.on_completed_checkpoint(ckpt)
-        runner.coordinator._ignored.update(ignored)
+        runner.coordinator.mark_ignored(ignored)
         spe = runner.executor.steps_per_epoch
         from_epoch = ckpt.checkpoint_id + 1
         L = job.total_subtasks()
@@ -1103,8 +1111,9 @@ class ClusterRunner:
         for j in range(k + 1):
             runner._fence_step[from_epoch + j] = fence + j * spe
         runner._ring_tail_mirror = fence
-        runner._ck_log_heads[ckpt.checkpoint_id] = np.asarray(
-            ckpt.carry.log_heads).astype(np.int64)
+        with runner._ck_heads_lock:
+            runner._ck_log_heads[ckpt.checkpoint_id] = np.asarray(
+                ckpt.carry.log_heads).astype(np.int64)
         _stage("finalize.state-rehydrate")
 
         # Overlapped finalize (the tentpole restructure): the roll-gap /
@@ -1517,8 +1526,9 @@ class ClusterRunner:
         runner.executor.step_in_epoch = 0
         runner._fence_step[from_epoch] = fence
         runner._ring_tail_mirror = fence
-        runner._ck_log_heads[ckpt.checkpoint_id] = np.asarray(
-            runner.executor.carry.logs.head).astype(np.int64)
+        with runner._ck_heads_lock:
+            runner._ck_log_heads[ckpt.checkpoint_id] = np.asarray(
+                runner.executor.carry.logs.head).astype(np.int64)
         c = runner.executor.carry
         new_rings = []
         for el in c.out_rings:
@@ -1741,14 +1751,16 @@ class ClusterRunner:
         # to the new epoch) — recovery's patch phase reads them from
         # here instead of paying a device round-trip on the failure
         # path.
-        self._ck_log_heads[closed] = vec[nf + 1:].astype(np.int64)
         # Bounded even when checkpoints never complete (the completion
         # hook prunes harder). Epochs arrive in monotonic order, so
         # evicting in insertion order is oldest-first and O(1) — a
         # pruned-but-needed entry only costs the patch fallback's one
         # device read.
-        while len(self._ck_log_heads) > 128:
-            self._ck_log_heads.pop(next(iter(self._ck_log_heads)))
+        with self._ck_heads_lock:
+            self._ck_log_heads[closed] = vec[nf + 1:].astype(np.int64)
+            while len(self._ck_log_heads) > 128:
+                self._ck_log_heads.pop(
+                    next(iter(self._ck_log_heads)))
         delta_records = total_records - self._last_records_total
         self._m_records.mark(delta_records)
         self._last_records_total = total_records
@@ -2300,7 +2312,8 @@ class ClusterRunner:
         # in the final packed read, and their replay defers its sync too.
         # On a tunneled device the round-trips ARE the warm recovery cost
         # (~100ms each vs a 133ms replay — r4's protocol bottleneck).
-        ck_heads = self._ck_log_heads.get(ckpt.checkpoint_id)
+        with self._ck_heads_lock:
+            ck_heads = self._ck_log_heads.get(ckpt.checkpoint_id)
         from clonos_tpu.api.operators import HostFeedSource
         prep: Dict[int, Dict[str, Any]] = {}
         slow_reads: List[Tuple[int, str, Any]] = []

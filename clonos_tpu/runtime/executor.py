@@ -1160,6 +1160,11 @@ class LocalExecutor:
                 ch = dg.channels.get(f"ring/v{vid}")
                 if ch is not None:
                     self.spill_logs[i].attach_digest(epoch, ch[1].hex())
+        # clonos: allow(join-discipline): det_store is attached during
+        # setup, before any worker thread starts, and never rebound;
+        # the tiered store's mutating methods serialize on its own
+        # internal lock (the race pass models collaborator method calls
+        # as mutations of the holder attribute).
         if self.det_store is not None:
             h = hashlib.blake2b(digest_size=8)
             for name in sorted(dg.channels):

@@ -435,11 +435,11 @@ class EdgeExportServer:
         tp.adopt_hlc(req, verb="FETCH_EDGE")
         eidx, start, count = (int(req["edge"]), int(req["start"]),
                               int(req["count"]))
-        if eidx not in self._recs:
-            return tp.ERROR, tp.pack_json(
-                {"error": f"edge {eidx} is not exported here "
-                          f"(have {sorted(self._recs)})"})
         with self._lock:
+            if eidx not in self._recs:
+                return tp.ERROR, tp.pack_json(
+                    {"error": f"edge {eidx} is not exported here "
+                              f"(have {sorted(self._recs)})"})
             arr = self._recs[eidx]
             final = self._final
         avail = arr.shape[0]

@@ -93,6 +93,26 @@ def pytest_configure(config):
                 f"--report json | python -c \"import json,sys; "
                 f"print(json.load(sys.stdin)['census_fingerprint'])\" "
                 f"> .clonos-census")
+    # Thread-census drift gate: the pinned fingerprint (.clonos-threads)
+    # must match — a new thread root appearing (or one being re-homed)
+    # silently is how an unreviewed concurrency interaction slips past
+    # the race pass's discharge reasoning.
+    tpin_path = os.path.join(_REPO_ROOT, ".clonos-threads")
+    if os.path.isfile(tpin_path):
+        with open(tpin_path) as f:
+            toks = f.read().split()
+        pinned = toks[0] if toks else ""
+        if aresult.threads_fingerprint != pinned:
+            raise pytest.UsageError(
+                f"thread-census drift: fingerprint "
+                f"{aresult.threads_fingerprint} != pinned {pinned} "
+                f"(.clonos-threads) — the thread-root population "
+                f"changed (a thread was added, removed, or re-homed); "
+                f"review `clonos_tpu analyze --threads`, then re-pin "
+                f"with\n  python -m clonos_tpu.cli analyze "
+                f"--report json --no-census | python -c \"import json,"
+                f"sys; print(json.load(sys.stdin)"
+                f"['threads_fingerprint'])\" > .clonos-threads")
     # Protocol model-checker gate (clonos_tpu verify --quick): every
     # safety invariant on every reachable state of the four protocol
     # models at the quick bound, sub-second and jax-free. A violation

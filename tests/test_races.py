@@ -1,0 +1,423 @@
+"""Whole-program static race detector (clonos_tpu/analysis/threads.py
++ races.py): thread-root inventory, lockset ∩ happens-before checking,
+and join discipline for the overlapped pipelines.
+
+The acceptance pairs:
+
+- Every seeded concurrency bug (``SEEDED_BUGS``) yields EXACTLY its
+  rule's finding, naming the racing attribute, both thread roots, and
+  the minimal call chain — while each bug's corrected twin in the same
+  module stays quiet.
+- The repo itself is race-clean: every race finding is discharged by a
+  happens-before edge or carries a justified waiver, so
+  ``clonos_tpu analyze --races --report json`` exits 0 at HEAD.
+- The thread-root census fingerprint matches the ``.clonos-threads``
+  pin (drift = a new/removed/re-homed thread root that must be
+  re-reviewed).
+"""
+
+import json
+import os
+import textwrap
+import time
+
+import pytest
+
+from clonos_tpu.analysis import (CallGraph, JOIN_DISCIPLINE,
+                                 LockOrderGraph, SEEDED_BUGS,
+                                 THREAD_RACE, ThreadInventory,
+                                 run_analysis, run_races,
+                                 seeded_findings, threads_fingerprint)
+from clonos_tpu.lint import FileContext
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+RACE_RULES = {THREAD_RACE, JOIN_DISCIPLINE}
+
+
+def _pipeline(src, name="mod.py"):
+    ctx = FileContext(name, textwrap.dedent(src))
+    graph = CallGraph([ctx])
+    return (ctx, graph, LockOrderGraph([ctx], graph),
+            ThreadInventory([ctx], graph))
+
+
+def _race_findings(src, name="mod.py"):
+    ctx, graph, lockgraph, inv = _pipeline(src, name)
+    return run_races([ctx], graph, lockgraph, inv)
+
+
+def _inventory(src, name="mod.py"):
+    return _pipeline(src, name)[3]
+
+
+# --- thread-root inventory ------------------------------------------------
+
+_METHOD_ROOT_SRC = """\
+    import threading
+
+    class Pump:
+        def __init__(self):
+            self._thread = threading.Thread(target=self._loop,
+                                            daemon=True)
+            self._thread.start()
+
+        def _loop(self):
+            pass
+
+        def close(self):
+            self._thread.join()
+    """
+
+
+def test_inventory_resolves_method_root():
+    inv = _inventory(_METHOD_ROOT_SRC)
+    (root,) = inv.roots
+    assert root.kind == "method"
+    assert root.entry == "mod.Pump._loop"
+    assert root.daemon is True
+    assert root.spawner == "mod.Pump.__init__"
+    assert [s[2] for s in root.start_sites] == ["mod.Pump.__init__"]
+    assert [s[2] for s in root.join_sites] == ["mod.Pump.close"]
+    assert root.joined
+
+
+def test_inventory_resolves_closure_root():
+    inv = _inventory("""\
+        import threading
+
+        class Job:
+            def run(self):
+                done = []
+                def _work():
+                    done.append(1)
+                t = threading.Thread(target=_work)
+                t.start()
+                t.join()
+                return done
+        """)
+    (root,) = inv.roots
+    assert root.kind == "closure"
+    assert root.entry == "mod.Job.run.<_work>"
+    assert root.joined
+
+
+def test_fingerprint_ignores_line_shifts_not_renames():
+    base = threads_fingerprint(_inventory(_METHOD_ROOT_SRC))
+    shifted = threads_fingerprint(_inventory(
+        "    # a comment that moves every line down\n"
+        + _METHOD_ROOT_SRC))
+    assert shifted == base
+    renamed = threads_fingerprint(_inventory(
+        _METHOD_ROOT_SRC.replace("_loop", "_pump_loop")))
+    assert renamed != base
+
+
+# --- seeded bugs: each rule provably bites --------------------------------
+
+@pytest.mark.parametrize("name", sorted(SEEDED_BUGS))
+def test_seeded_bug_yields_minimal_counterexample(name):
+    spec = SEEDED_BUGS[name]
+    findings = seeded_findings(name)
+    assert len(findings) == 1, [f.message for f in findings]
+    (f,) = findings
+    assert f.rule == spec["rule"]
+    assert f.severity == "error"
+    # the finding names the racing attribute, BOTH roots, and a chain
+    assert f"`{spec['attr']}`" in f.message
+    assert "thread roots" in f.message
+    assert "chain[" in f.message
+
+
+def test_seeded_bug_corrected_twins_stay_quiet():
+    # each seed module carries a corrected twin of its bug; the only
+    # finding is the seeded one, and the twin attr is never named
+    twins = {"drop-a-join": "_joined_product",
+             "unguarded-cross-thread-write": "_guarded",
+             "queue-bypass": "_q"}
+    for name, twin in twins.items():
+        for f in seeded_findings(name):
+            assert twin not in f.message.split(";")[0]
+
+
+def test_unknown_seed_name_rejected():
+    with pytest.raises(ValueError, match="drop-a-join"):
+        seeded_findings("no-such-bug")
+
+
+# --- happens-before discharges --------------------------------------------
+
+def test_shared_lock_discharges():
+    assert _race_findings("""\
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._totals = {}
+                self._t = threading.Thread(target=self._loop)
+                self._t.start()
+
+            def _loop(self):
+                with self._lock:
+                    self._totals["beat"] = 1
+
+            def bump(self):
+                with self._lock:
+                    self._totals["n"] = 1
+        """) == []
+
+
+def test_condition_guard_discharges():
+    # threading.Condition is a lock for guard purposes (type-resolved,
+    # no name hint: "_cv" says nothing)
+    assert _race_findings("""\
+        import threading
+
+        class C:
+            def __init__(self):
+                self._cv = threading.Condition()
+                self._items = []
+                self._t = threading.Thread(target=self._loop)
+                self._t.start()
+
+            def _loop(self):
+                with self._cv:
+                    self._items.append(1)
+
+            def take(self):
+                with self._cv:
+                    return self._items.pop()
+        """) == []
+
+
+def test_queue_handoff_discharges():
+    assert _race_findings("""\
+        import queue
+        import threading
+
+        class C:
+            def __init__(self):
+                self._q = queue.Queue()
+                self._t = threading.Thread(target=self._loop)
+                self._t.start()
+
+            def _loop(self):
+                self._q.put(1)
+
+            def take(self):
+                return self._q.get()
+        """) == []
+
+
+def test_prestart_publication_discharges():
+    # the spawner writes BEFORE start(): Thread.start() is a
+    # happens-before edge, the worker's unguarded read is ordered
+    assert _race_findings("""\
+        import threading
+
+        class C:
+            def __init__(self):
+                self._cfg = {}
+                self._cfg["mode"] = "fast"
+                self._t = threading.Thread(target=self._loop)
+                self._t.start()
+
+            def _loop(self):
+                return self._cfg["mode"]
+        """) == []
+
+
+def test_join_dominance_discharges():
+    assert _race_findings("""\
+        import threading
+
+        class C:
+            def __init__(self):
+                self._out = []
+                self._t = threading.Thread(target=self._work)
+
+            def _work(self):
+                self._out.append(1)
+
+            def run(self):
+                self._t.start()
+                self._t.join()
+                return list(self._out)
+        """) == []
+
+
+def test_plain_scalar_publish_discharges():
+    # reference-swap publish: every write is a plain `self.x = ...`
+    # rebind, so a bare read is a GIL-atomic reference read
+    assert _race_findings("""\
+        import threading
+
+        class C:
+            def __init__(self):
+                self.result = None
+                self._t = threading.Thread(target=self._work)
+                self._t.start()
+
+            def _work(self):
+                self.result = 42
+
+            def peek(self):
+                return self.result
+        """) == []
+
+
+# --- waivers ---------------------------------------------------------------
+
+def _analyze_src(tmp_path, monkeypatch, files, use_waivers=True):
+    monkeypatch.chdir(tmp_path)
+    for name, src in files.items():
+        (tmp_path / name).write_text(textwrap.dedent(src))
+    return run_analysis(sorted(files), use_waivers=use_waivers)
+
+
+_RACY_SRC = """\
+    import threading
+
+    class C:
+        def __init__(self):
+            self._totals = {}
+            self._t = threading.Thread(target=self._loop)
+            self._t.start()
+
+        def _loop(self):
+            __WAIVER__self._totals["beat"] = 1
+
+        def bump(self):
+            self._totals["n"] = 1
+    """
+
+
+def test_inline_waiver_suppresses_and_persists(tmp_path, monkeypatch):
+    waiver = ("# clonos: allow(thread-race): test fixture\n"
+              "            ")
+    res = _analyze_src(
+        tmp_path, monkeypatch,
+        {"mod.py": _RACY_SRC.replace("__WAIVER__", waiver)})
+    races = [f for f in res.findings if f.rule in RACE_RULES]
+    assert races and all(f.waived for f in races)
+    assert res.ok
+
+    # without the waiver the same source fails
+    res = _analyze_src(
+        tmp_path, monkeypatch,
+        {"mod.py": _RACY_SRC.replace("__WAIVER__", "")})
+    races = [f for f in res.findings if f.rule in RACE_RULES]
+    assert races and not any(f.waived for f in races)
+    assert res.exit_code() == 1
+
+
+def test_stale_race_waiver_warns(tmp_path, monkeypatch):
+    res = _analyze_src(tmp_path, monkeypatch, {"mod.py": """\
+        # clonos: allow(join-discipline): nothing to waive here
+        X = 1
+        """})
+    assert any(f.rule == "stale-waiver"
+               and "join-discipline" in f.message
+               for f in res.warnings)
+
+
+# --- the repo itself -------------------------------------------------------
+
+def test_repo_is_race_clean(monkeypatch):
+    """Every race finding in the repo is waived with a justification —
+    the `clonos_tpu analyze --races` CI gate, in-process."""
+    monkeypatch.chdir(_REPO)
+    res = run_analysis(["clonos_tpu", "examples"])
+    assert res.errors == [], "\n".join(
+        f"{f.location()}: [{f.rule}] {f.message}" for f in res.errors)
+    races = [f for f in res.findings if f.rule in RACE_RULES]
+    assert races, "race pass found nothing at all — lost its teeth?"
+    assert all(f.waived for f in races)
+
+
+def test_repo_thread_census_matches_pin(monkeypatch):
+    monkeypatch.chdir(_REPO)
+    res = run_analysis(["clonos_tpu", "examples"])
+    with open(os.path.join(_REPO, ".clonos-threads")) as f:
+        pinned = f.read().split()[0]
+    assert res.threads_fingerprint == pinned, (
+        "thread-root census drifted; review `clonos_tpu analyze "
+        "--threads` and re-pin .clonos-threads")
+    assert res.threads["roots"], "empty thread inventory"
+
+
+# --- CLI -------------------------------------------------------------------
+
+def test_cli_races_json_exits_zero_at_head(monkeypatch, capsys):
+    from clonos_tpu import cli
+
+    monkeypatch.chdir(_REPO)
+    rc = cli.main(["analyze", "--races", "--report", "json",
+                   "--no-census"])
+    out = capsys.readouterr().out.strip()
+    assert rc == 0
+    rep = json.loads(out)
+    assert rep["ok"] is True
+    assert all(f["rule"] in RACE_RULES or "waiver" in f["rule"]
+               for f in rep["findings"])
+    assert rep["threads_fingerprint"]
+
+
+@pytest.mark.parametrize("name", sorted(SEEDED_BUGS))
+def test_cli_seed_bug_exits_one_with_counterexample(name, capsys):
+    from clonos_tpu import cli
+
+    rc = cli.main(["analyze", "--seed-bug", name])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert SEEDED_BUGS[name]["rule"] in out
+    assert SEEDED_BUGS[name]["attr"] in out
+
+
+def test_cli_seed_bug_unknown_exits_two(capsys):
+    from clonos_tpu import cli
+
+    assert cli.main(["analyze", "--seed-bug", "no-such"]) == 2
+
+
+def test_cli_expect_threads_gate(monkeypatch, capsys):
+    from clonos_tpu import cli
+
+    monkeypatch.chdir(_REPO)
+    rc = cli.main(["analyze", "--races", "--expect-threads",
+                   ".clonos-threads"])
+    capsys.readouterr()
+    assert rc == 0
+    rc = cli.main(["analyze", "--races", "--expect-threads",
+                   "0" * 16])
+    err = capsys.readouterr().err
+    assert rc == 1
+    assert "thread-census drift" in err
+
+
+# --- satellite: cross-host wall-clock lease regression ---------------------
+
+def test_lease_deadlines_are_wall_clock_not_per_boot(tmp_path):
+    """Regression (advisor round 5, since fixed): lease deadlines in
+    the shared claim file must be WALL-CLOCK — claim files are read by
+    contenders on other hosts, where a per-boot CLOCK_MONOTONIC value
+    is meaningless (premature takeover or failover that never fires)."""
+    from clonos_tpu.runtime.leader import FileLeaderElection
+
+    lease = str(tmp_path / "lease")
+    a = FileLeaderElection(lease, "jm-a", lease_ttl_s=30.0)
+    assert a.try_acquire()
+    with open(f"{lease}.epoch1.claim") as f:
+        rec = json.load(f)
+    # wall-clock epoch seconds, not a small per-boot monotonic value
+    assert abs(rec["deadline_wall"] - (time.time() + 30.0)) < 60.0
+
+    # a contender on another "host" (its own clock object) reads the
+    # same file and agrees the lease is live, then sees it lapse
+    b = FileLeaderElection(lease, "jm-b", lease_ttl_s=30.0,
+                           clock=lambda: time.time())
+    assert b.leader() == "jm-a"
+    assert not b.try_acquire()
+    b._clock = lambda: time.time() + 3600.0   # an hour later, anywhere
+    assert b.try_acquire() and b.epoch == 2
